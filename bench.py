@@ -1,0 +1,87 @@
+"""Benchmark harness: GraNd scoring throughput (the BASELINE.json headline metric).
+
+Emits ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md) — the north-star target stands in as
+baseline: full GraNd scoring of CIFAR-10 (50 000 examples x 10 seeds) in under 60 s
+on a v4-8, i.e. 8 333 examples/sec aggregate. ``vs_baseline`` is measured
+per-chip examples/sec divided by the per-chip north-star rate (8 333 / 4 dual-core
+v4 chips ~ 2 083 examples/sec/chip).
+
+Run: ``python bench.py [--size N] [--batch B] [--method grand|el2n] [--arch A]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+NORTH_STAR_EXAMPLES_PER_SEC = 8333.0   # 50k x 10 seeds / 60 s
+NORTH_STAR_CHIPS = 4.0                 # v4-8 = 4 dual-core chips
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=4096,
+                        help="examples in the scoring pass")
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--method", default="grand",
+                        choices=["grand", "el2n", "grand_last_layer"])
+    parser.add_argument("--arch", default="resnet18")
+    parser.add_argument("--chunk", type=int, default=64,
+                        help="vmap(grad) chunk per device for full GraNd")
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+
+    import jax
+
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder, iterate_batches
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.ops.scores import make_score_step
+    from data_diet_distributed_tpu.parallel.mesh import make_mesh, replicate
+
+    n_devices = len(jax.devices())
+    mesh = make_mesh(None)
+    sharder = BatchSharder(mesh)
+    batch_size = sharder.global_batch_size_for(args.batch)
+
+    train_ds, _ = load_dataset("synthetic", synthetic_size=args.size, seed=0)
+    model = create_model(args.arch, 10, half_precision=True)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0),
+        np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
+    variables = replicate(variables, mesh)
+
+    step = make_score_step(model, args.method, mesh, chunk=args.chunk)
+    device_batches = [sharder(b) for b in
+                      iterate_batches(train_ds, batch_size, shuffle=False)]
+
+    # Warmup: compile + one full pass.
+    out = [step(variables, b) for b in device_batches]
+    jax.block_until_ready(out[-1])
+
+    t0 = time.perf_counter()
+    for _ in range(args.repeats):
+        out = [step(variables, b) for b in device_batches]
+    jax.block_until_ready(out[-1])
+    wall = time.perf_counter() - t0
+
+    examples_per_sec = args.size * args.repeats / wall
+    per_chip = examples_per_sec / n_devices
+    vs_baseline = per_chip / (NORTH_STAR_EXAMPLES_PER_SEC / NORTH_STAR_CHIPS)
+
+    print(json.dumps({
+        "metric": f"{args.method}_scoring_examples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""data_diet_distributed_tpu — a TPU-native framework for Data Diet dataset pruning.
+
+Re-implements, TPU-first (JAX/Flax/XLA/pjit/pallas), the full capability surface of the
+PyTorch/DDP reference ``TejasPote/data_diet_distributed``:
+
+* per-example **EL2N** scores (reference: ``get_scores_and_prune.py:15-18``) and the paper's
+  **GraNd** per-example gradient-norm score, which the reference lacks;
+* keep-hardest top-``(1 - sparsity)`` pruning (reference: ``get_scores_and_prune.py:22-27``);
+* dense / prune-then-retrain training with SGD + momentum + cosine decay
+  (reference: ``train.py``, ``train_sparse.py``, ``trainer/trainer.py``);
+* distributed execution. The reference uses NCCL ``DistributedDataParallel``
+  (``ddp.py:24-27,141``); here distribution is a ``jax.sharding.Mesh`` with
+  ``NamedSharding``-annotated ``jit`` programs, so gradient reduction, eval-metric
+  reduction, and score all-gathers are XLA collectives over ICI/DCN;
+* unified Orbax checkpointing (one schema — the reference has two incompatible ones,
+  ``trainer/trainer.py:64-71`` vs ``ddp.py:116-123``), JSONL step metrics, resource
+  monitoring, and profiler hooks (reference: ``ddp_new.py:21-99``).
+
+Package layout::
+
+    config.py    typed dataclass config, YAML + CLI dot-overrides
+    data/        CIFAR-10/100 host arrays with global index plumbing; sharded batching
+    models/      Flax ResNet-18/34/50/101/152 (CIFAR geometry) + WideResNet-28-10
+    ops/         EL2N / GraNd per-example score kernels (incl. a Pallas EL2N kernel)
+    pruning.py   top-k keep-hardest index selection
+    train/       jitted train/eval steps, epoch driver, two-phase score->prune->retrain
+    parallel/    mesh construction, sharding specs, multi-host init, score gathering
+    checkpoint.py  Orbax: one schema {params, batch_stats, opt_state, step, metrics}
+    obs/         JSONL metrics, device-memory / host monitor, jax.profiler hooks
+    cli.py       entry points: train / score / prune-retrain / bench
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,96 @@
+"""Command-line entry points.
+
+One CLI replaces the reference's four overlapping scripts (``train.py``,
+``train_sparse.py``, ``ddp.py``, ``ddp_new.py`` — the latter a near-verbatim copy of
+``ddp.py`` plus monitoring, SURVEY layer-map note). Monitoring is a flag, not a fork::
+
+    python -m data_diet_distributed_tpu.cli run   --config configs/cifar10_resnet18.yaml
+    python -m data_diet_distributed_tpu.cli train --config ... train.num_epochs=5
+    python -m data_diet_distributed_tpu.cli score --config ... score.method=grand
+
+Any config key is overridable as a trailing ``dotted.key=value`` argument.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from .config import Config, load_config
+from .obs import MetricsLogger, ResourceMonitor
+
+
+def _build(argv: list[str]) -> tuple[str, Config]:
+    parser = argparse.ArgumentParser(prog="data_diet_distributed_tpu")
+    parser.add_argument("command", choices=["run", "train", "score"],
+                        help="run = score->prune->retrain end-to-end; "
+                             "train = dense training only; "
+                             "score = compute+save per-example scores only")
+    parser.add_argument("--config", default=None, help="YAML config path")
+    parser.add_argument("overrides", nargs="*", help="dotted.key=value overrides")
+    args = parser.parse_args(argv)
+    return args.command, load_config(args.config, args.overrides)
+
+
+def main(argv: list[str] | None = None) -> int:
+    command, cfg = _build(sys.argv[1:] if argv is None else argv)
+    from .parallel.mesh import initialize_multihost
+    initialize_multihost(cfg.mesh)
+
+    monitor = ResourceMonitor(cfg.obs.monitor_path) if cfg.obs.monitor else None
+    if monitor:
+        monitor.start()
+    logger = MetricsLogger(cfg.obs.metrics_path)
+    from .obs import trace
+    try:
+        with trace(cfg.obs.profile_dir):
+            _dispatch(command, cfg, logger)
+    finally:
+        logger.close()
+        if monitor:
+            monitor.stop()
+    return 0
+
+
+def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
+    if command == "run":
+        from .train.loop import run_datadiet
+        run_datadiet(cfg, logger)
+    elif command == "train":
+        from .data.datasets import load_dataset
+        from .train.loop import fit
+        train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
+                                         cfg.data.synthetic_size,
+                                         seed=cfg.train.seed)
+        fit(cfg, train_ds, test_ds, logger=logger,
+            checkpoint_dir=cfg.train.checkpoint_dir, tag="dense")
+    elif command == "score":
+        from .data.datasets import load_dataset
+        from .data.pipeline import BatchSharder
+        from .models import create_model
+        from .ops.scoring import score_dataset
+        from .parallel.mesh import make_mesh
+        from .train.loop import score_variables_for_seeds
+        mesh = make_mesh(cfg.mesh)
+        sharder = BatchSharder(mesh)
+        train_ds, _ = load_dataset(cfg.data.dataset, cfg.data.data_dir,
+                                   cfg.data.synthetic_size, seed=cfg.train.seed)
+        seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
+                                               sharder=sharder, logger=logger)
+        model = create_model(cfg.model.arch, cfg.model.num_classes,
+                             cfg.train.half_precision)
+        scores = score_dataset(model, seeds_vars, train_ds,
+                               method=cfg.score.method,
+                               batch_size=cfg.score.batch_size,
+                               sharder=sharder, chunk=cfg.score.grand_chunk,
+                               eval_mode=cfg.score.eval_mode)
+        out = f"{cfg.train.checkpoint_dir}_scores.npz"
+        np.savez(out, scores=scores, indices=train_ds.indices)
+        logger.log("scores_saved", path=out, n=len(scores),
+                   mean=float(scores.mean()), std=float(scores.std()))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,9 @@
+from .datasets import (ArrayDataset, CIFAR10_MEAN, CIFAR10_STD, CIFAR100_MEAN,
+                       CIFAR100_STD, load_dataset)
+from .pipeline import BatchSharder, epoch_permutation, iterate_batches, num_batches
+
+__all__ = [
+    "ArrayDataset", "load_dataset", "BatchSharder", "epoch_permutation",
+    "iterate_batches", "num_batches", "CIFAR10_MEAN", "CIFAR10_STD",
+    "CIFAR100_MEAN", "CIFAR100_STD",
+]

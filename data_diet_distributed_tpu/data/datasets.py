@@ -1,0 +1,156 @@
+"""Dataset loading into host-RAM numpy arrays with explicit global indices.
+
+The reference's one structurally good idea is index plumbing: its ``MyDataset`` wrapper
+returns ``(idx, image, label)`` so per-example scores can be joined back to examples
+(``data/loader.py:13-25``). Here that idea becomes explicit: a dataset IS a triple of
+arrays ``(images[N,H,W,C], labels[N], indices[N])`` and subsets are index arrays —
+never loader objects, which is the hand-off the reference's DDP path got wrong
+(it passed DataLoader objects across the spawn boundary, ``ddp.py:75-80``; SURVEY §2.4.2).
+
+Loading is from local files only (CIFAR python-pickle batches, the format torchvision
+writes to ``cifar-10-batches-py``); there is deliberately no network download. When no
+local copy exists, the deterministic ``synthetic`` dataset provides identically-shaped
+data so every code path (scoring, pruning, training, distribution) runs anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+# Channel statistics identical to the reference transform (data/loader.py:8-11) so
+# score parity against the torch oracle is exact at the input layer.
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+
+@dataclass(frozen=True)
+class ArrayDataset:
+    """Images in NHWC float32 (normalized), integer labels, and GLOBAL indices.
+
+    ``indices[i]`` is the example's identity in the full dataset; it survives
+    subsetting, sharding, and shuffling, so a score computed anywhere on the mesh can
+    always be joined back to its example.
+    """
+
+    images: np.ndarray    # [N, H, W, C] float32
+    labels: np.ndarray    # [N] int32
+    indices: np.ndarray   # [N] int32, global example ids
+    num_classes: int
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def subset(self, keep: np.ndarray) -> "ArrayDataset":
+        """Take rows by POSITION-in-this-dataset of global index.
+
+        ``keep`` contains global example ids (as produced by pruning); they are mapped
+        through ``indices`` so subsetting composes.
+        """
+        pos = _positions_of(self.indices, keep)
+        return replace(self, images=self.images[pos], labels=self.labels[pos],
+                       indices=self.indices[pos])
+
+
+def _positions_of(index_arr: np.ndarray, wanted: np.ndarray) -> np.ndarray:
+    lookup = np.full(index_arr.max() + 1, -1, np.int64)
+    lookup[index_arr] = np.arange(len(index_arr))
+    pos = lookup[wanted]
+    if (pos < 0).any():
+        raise KeyError("requested global indices not present in dataset")
+    return pos
+
+
+def _load_cifar_batches(data_dir: str, name: str):
+    """Parse the standard CIFAR python-pickle format from a local directory or tarball."""
+    sub = {"cifar10": "cifar-10-batches-py", "cifar100": "cifar-100-python"}[name]
+    root = os.path.join(data_dir, sub)
+    tar = {
+        "cifar10": os.path.join(data_dir, "cifar-10-python.tar.gz"),
+        "cifar100": os.path.join(data_dir, "cifar-100-python.tar.gz"),
+    }[name]
+    if not os.path.isdir(root) and os.path.exists(tar):
+        with tarfile.open(tar) as tf:
+            tf.extractall(data_dir)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"no local {name} at {root} (and no tarball at {tar}); "
+            "place the standard python-pickle batches there, or use dataset=synthetic")
+
+    if name == "cifar10":
+        train_files = [os.path.join(root, f"data_batch_{i}") for i in range(1, 6)]
+        test_files = [os.path.join(root, "test_batch")]
+        label_key = b"labels"
+    else:
+        train_files = [os.path.join(root, "train")]
+        test_files = [os.path.join(root, "test")]
+        label_key = b"fine_labels"
+
+    def read(files):
+        xs, ys = [], []
+        for f in files:
+            with open(f, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.append(np.asarray(d[label_key], np.int32))
+        x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NCHW->NHWC
+        return x, np.concatenate(ys)
+
+    return read(train_files), read(test_files)
+
+
+def _normalize(x_uint8: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    return ((x_uint8.astype(np.float32) / 255.0) - mean) / std
+
+
+def _synthetic(size: int, num_classes: int, seed: int, split: str):
+    """Deterministic class-structured fake CIFAR: each class gets a fixed template plus
+    noise, so models can actually learn and pruning scores are non-degenerate. The
+    templates depend only on ``seed`` — train and test splits share them (different
+    noise), so generalization is measurable."""
+    template_rng = np.random.default_rng(np.random.SeedSequence([seed, 0xD1E7]))
+    # Two signal components: a spatial template (rich per-example score structure) and
+    # a per-channel signature (survives global average pooling, so GAP-headed conv
+    # nets separate classes within a few optimizer steps).
+    templates = template_rng.normal(
+        0.0, 0.5, size=(num_classes, 32, 32, 3)).astype(np.float32)
+    channel_sig = template_rng.normal(
+        0.0, 1.0, size=(num_classes, 1, 1, 3)).astype(np.float32)
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed, 1 if split == "train" else 2]))
+    labels = rng.integers(0, num_classes, size=size).astype(np.int32)
+    noise = rng.normal(0.0, 0.4, size=(size, 32, 32, 3)).astype(np.float32)
+    images = templates[labels] + channel_sig[labels] + noise
+    return images, labels
+
+
+def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2048,
+                 seed: int = 0) -> tuple[ArrayDataset, ArrayDataset]:
+    """Return ``(train, test)`` ArrayDatasets (reference: ``data/loader.py:27-43``)."""
+    if dataset == "synthetic":
+        train_x, train_y = _synthetic(synthetic_size, 10, seed, "train")
+        test_x, test_y = _synthetic(max(synthetic_size // 4, 64), 10, seed, "test")
+        num_classes = 10
+    elif dataset in ("cifar10", "cifar100"):
+        (train_raw, train_y), (test_raw, test_y) = _load_cifar_batches(data_dir, dataset)
+        mean, std = ((CIFAR10_MEAN, CIFAR10_STD) if dataset == "cifar10"
+                     else (CIFAR100_MEAN, CIFAR100_STD))
+        train_x = _normalize(train_raw, mean, std)
+        test_x = _normalize(test_raw, mean, std)
+        num_classes = 10 if dataset == "cifar10" else 100
+    else:
+        raise ValueError(f"unknown dataset {dataset!r}")
+
+    def make(x, y):
+        return ArrayDataset(images=np.ascontiguousarray(x),
+                            labels=y.astype(np.int32),
+                            indices=np.arange(len(y), dtype=np.int32),
+                            num_classes=num_classes)
+
+    return make(train_x, train_y), make(test_x, test_y)

@@ -1,0 +1,38 @@
+"""Model zoo registry.
+
+The reference exposes factories ``ResNet18..152`` (``models/resnet.py:100-117``); here
+they are looked up by config string so the trainer/scorer are model-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .tiny import TinyCNN, TinyCNNFactory
+from .wideresnet import WideResNet, WideResNet28_10
+
+_REGISTRY = {
+    "tiny_cnn": TinyCNNFactory,
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+    "wideresnet28_10": WideResNet28_10,
+}
+
+
+def create_model(arch: str, num_classes: int, half_precision: bool = False):
+    """Instantiate a model by name. ``half_precision`` selects bfloat16 compute
+    (fp32 params) — the TPU-native mixed-precision recipe."""
+    if arch not in _REGISTRY:
+        raise ValueError(f"unknown arch {arch!r}; known: {sorted(_REGISTRY)}")
+    dtype = jnp.bfloat16 if half_precision else jnp.float32
+    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype)
+
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "TinyCNN", "WideResNet", "WideResNet28_10", "create_model",
+]

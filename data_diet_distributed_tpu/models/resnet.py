@@ -1,0 +1,150 @@
+"""CIFAR-geometry ResNets in Flax linen.
+
+Capability parity with the reference model zoo (``models/resnet.py:100-117``:
+ResNet-18/34/50/101/152 with a 3x3 stem, no max-pool, stage widths 64/128/256/512,
+strides 1/2/2/2, global 4x4 average pool for 32x32 inputs) — but written TPU-first:
+
+* NHWC layout (XLA's preferred TPU conv layout; torch reference is NCHW);
+* BatchNorm as a Flax ``batch_stats`` collection with an explicit ``train`` flag —
+  the scoring pass runs in eval mode by design (the reference accidentally scored in
+  train mode and mutated running stats, SURVEY.md §2.4.1);
+* optional bfloat16 compute dtype with float32 parameters/statistics, so matmuls and
+  convs hit the MXU at full rate while score math stays numerically stable;
+* global average pooling (``mean`` over H,W) instead of the reference's hard-coded
+  ``avg_pool2d(out, 4)`` (``models/resnet.py:94``), so non-32x32 inputs (ImageNet
+  subset config) work unchanged.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# He-normal matches torch's default conv init family. Residual branches zero-init
+# their closing BatchNorm scale (see the blocks), so each block starts as identity —
+# the standard deep-ResNet trick.
+conv_init = nn.initializers.he_normal()
+
+# Symmetric 1-pixel padding for 3x3 convs: identical to torch Conv2d(padding=1).
+# XLA's SAME would pad (0,1) for stride-2 on even sizes — same shape, shifted
+# pixels — which would break exact-weight-port score parity with the oracle.
+PAD1 = ((1, 1), (1, 1))
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs with identity/projection shortcut (expansion 1)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      padding=PAD1)(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), padding=PAD1)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                name="proj_conv")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (expansion 4)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      padding=PAD1)(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1),
+                strides=(self.strides, self.strides), name="proj_conv")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet over NHWC inputs.
+
+    ``apply`` returns logits. Feature embedding (pre-classifier pooled activations)
+    is exposed via ``capture_features=True`` for the last-layer GraNd approximation.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: type
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, capture_features: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        x = conv(self.width, (3, 3), padding=PAD1, name="stem_conv")(x)
+        x = nn.relu(norm(name="stem_norm")(x))
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2 ** stage)
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = self.block_cls(filters=filters, strides=strides,
+                                   conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))            # global average pool (NHWC -> NC)
+        features = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="classifier")(x)
+        logits = logits.astype(jnp.float32)
+        if capture_features:
+            return logits, features
+        return logits
+
+
+def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, dtype=dtype)
+
+
+def ResNet34(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+
+
+def ResNet101(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+
+
+def ResNet152(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,45 @@
+"""A deliberately small ConvNet for tests, CI, and compile-latency-sensitive paths.
+
+Not part of the reference zoo; exists so the full pipeline (train → score → prune →
+retrain, sharded) can be exercised in seconds on a CPU mesh. Same interface contract
+as the ResNets: ``__call__(x, train=..., capture_features=...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import PAD1, conv_init
+
+
+class TinyCNN(nn.Module):
+    num_classes: int = 10
+    width: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, capture_features: bool = False):
+        x = x.astype(self.dtype)
+        for i, w in enumerate((self.width, self.width * 2)):
+            x = nn.Conv(w, (3, 3), strides=(2, 2), padding=PAD1, use_bias=False,
+                        kernel_init=conv_init, dtype=self.dtype,
+                        param_dtype=jnp.float32)(x)
+            # momentum 0.5: running stats converge in tens of steps (tiny test runs)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.5,
+                             dtype=self.dtype, param_dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        features = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="classifier")(x)
+        logits = logits.astype(jnp.float32)
+        if capture_features:
+            return logits, features
+        return logits
+
+
+def TinyCNNFactory(num_classes: int = 10, dtype=jnp.float32) -> TinyCNN:
+    return TinyCNN(num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,81 @@
+"""WideResNet-28-10 in Flax linen (pre-activation, Zagoruyko & Komodakis 2016).
+
+The reference has no WideResNet; BASELINE.json config 4 ("WideResNet-28-10 / CIFAR-100,
+prune {30,50,70}% sweep") requires it, and the Data Diet paper's headline CIFAR-10
+results use WRN-28-10. Pre-activation blocks, NHWC, bfloat16-compute friendly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import PAD1, conv_init
+
+
+class WideBlock(nn.Module):
+    """Pre-activation wide basic block: BN-ReLU-Conv3x3 twice + shortcut."""
+
+    filters: int
+    strides: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(self.norm()(x))
+        # Projection branches off the pre-activation (standard pre-act ResNet wiring).
+        if x.shape[-1] != self.filters or self.strides != 1:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="proj_conv")(y)
+        else:
+            residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      padding=PAD1)(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), padding=PAD1)(y)
+        return residual + y
+
+
+class WideResNet(nn.Module):
+    """WRN-d-k: depth d = 6n+4, widen factor k. WRN-28-10 -> n=4, k=10."""
+
+    depth: int = 28
+    widen_factor: int = 10
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, capture_features: bool = False):
+        if (self.depth - 4) % 6 != 0:
+            raise ValueError("WideResNet depth must be 6n+4")
+        n = (self.depth - 4) // 6
+        k = self.widen_factor
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        x = conv(16, (3, 3), padding=PAD1, name="stem_conv")(x)
+        for stage, filters in enumerate((16 * k, 32 * k, 64 * k)):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = WideBlock(filters=filters, strides=strides, conv=conv, norm=norm)(x)
+        x = nn.relu(norm(name="final_norm")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        features = x.astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=jnp.float32, name="classifier")(x)
+        logits = logits.astype(jnp.float32)
+        if capture_features:
+            return logits, features
+        return logits
+
+
+def WideResNet28_10(num_classes: int = 10, dtype=jnp.float32) -> WideResNet:
+    return WideResNet(depth=28, widen_factor=10, num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,5 @@
+from .metrics import MetricsLogger
+from .monitor import ResourceMonitor, sample_devices
+from .profiler import StepTimer, trace
+
+__all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer", "trace"]

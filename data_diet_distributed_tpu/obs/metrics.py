@@ -1,0 +1,44 @@
+"""Structured JSONL step metrics (replacing the reference's bare prints,
+``trainer/trainer.py:59-60``, ``ddp.py:106,124,158``).
+
+One line per event, process-0 gated, flushed eagerly so a crashed run still has its
+history. The schema is flat JSON so anything (pandas, jq, TensorBoard import) can
+consume it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, IO
+
+import jax
+
+
+class MetricsLogger:
+    def __init__(self, path: str | None, echo: bool = True):
+        self.echo = echo
+        self._fh: IO[str] | None = None
+        if path and jax.process_index() == 0:
+            self._fh = open(path, "a", buffering=1)
+
+    def log(self, kind: str, **fields: Any) -> None:
+        if jax.process_index() != 0:
+            return
+        record = {"ts": round(time.time(), 3), "kind": kind, **fields}
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+        if self.echo:
+            body = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
+            print(f"[{kind}] {body}", flush=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)

@@ -1,0 +1,79 @@
+"""Resource-utilization monitor: host CPU + device HBM, 1 Hz, in-process thread.
+
+Replaces the reference's sidecar ``mp.Process`` writing free-text lines later re-parsed
+with a buggy parser (``ddp_new.py:21-60, 274-309``; SURVEY §2.4.8). Differences by
+design: a daemon thread (no fork, no IPC), JSONL output (no parsing step), host CPU
+from ``/proc/stat`` (no psutil dependency), and device memory from
+``Device.memory_stats()`` (the TPU equivalent of ``torch.cuda.memory_allocated``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+
+
+def _cpu_times() -> tuple[float, float]:
+    with open("/proc/stat") as fh:
+        parts = fh.readline().split()[1:]
+    vals = [float(p) for p in parts]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0.0)
+    return sum(vals), idle
+
+
+def sample_devices() -> list[dict]:
+    out = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # not all backends implement memory_stats
+            pass
+        out.append({
+            "device": str(d),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return out
+
+
+class ResourceMonitor:
+    def __init__(self, path: str, interval_s: float = 1.0):
+        self.path = path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ResourceMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        prev_total, prev_idle = _cpu_times()
+        with open(self.path, "a", buffering=1) as fh:
+            while not self._stop.wait(self.interval_s):
+                total, idle = _cpu_times()
+                dt, di = total - prev_total, idle - prev_idle
+                prev_total, prev_idle = total, idle
+                cpu_pct = 100.0 * (1.0 - di / dt) if dt > 0 else 0.0
+                fh.write(json.dumps({
+                    "ts": round(time.time(), 3),
+                    "cpu_pct": round(cpu_pct, 1),
+                    "devices": sample_devices(),
+                }) + "\n")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

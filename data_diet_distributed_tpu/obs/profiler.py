@@ -1,0 +1,42 @@
+"""jax.profiler integration — trace capture for TensorBoard/Perfetto.
+
+The reference has no profiler at all (SURVEY §5.1: coarse wall-clock to
+``runtime_log.txt`` only). Wrap any region in ``trace(cfg.obs.profile_dir)`` to get a
+full XLA/TPU trace: per-op HLO timing, HBM usage, ICI collective overlap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(profile_dir: str | None):
+    if not profile_dir:
+        yield
+        return
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock per-step timing with warmup discard (compile steps excluded)."""
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = warmup
+        self.times: list[float] = []
+        self._count = 0
+
+    def record(self, seconds: float) -> None:
+        self._count += 1
+        if self._count > self.warmup:
+            self.times.append(seconds)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else float("nan")

@@ -1,0 +1,10 @@
+from .scores import (cross_entropy, el2n_from_logits, grand_last_layer_from_logits,
+                     make_el2n_step, make_grand_last_layer_step, make_grand_step,
+                     make_score_step)
+from .scoring import score_dataset
+
+__all__ = [
+    "cross_entropy", "el2n_from_logits", "grand_last_layer_from_logits",
+    "make_el2n_step", "make_grand_last_layer_step", "make_grand_step",
+    "make_score_step", "score_dataset",
+]

@@ -1,0 +1,168 @@
+"""Per-example importance-score kernels: EL2N and GraNd.
+
+EL2N (reference: ``get_scores_and_prune.py:15-18``): ``‖softmax(f(x)) − onehot(y)‖₂``.
+GraNd (Paul et al. 2021; ABSENT from the reference): ``‖∇_θ ℓ(f(x), y)‖₂`` per example.
+
+TPU-first design decisions:
+
+* scoring runs in **eval mode** (frozen BatchNorm statistics) — the reference
+  accidentally scored in train mode, mutating running stats (SURVEY §2.4.1);
+* the dataset pass is sharded over the mesh's ``data`` axis — every device scores its
+  shard concurrently, where the reference scored the whole set on one GPU
+  (``ddp.py:56``);
+* full GraNd is a ``vmap(grad)`` per-example backward, chunked with ``lax.map`` inside
+  ``shard_map`` so peak memory is ``chunk`` gradients per device while the MXU still
+  sees batched convs;
+* last-layer GraNd is closed-form — for a linear classifier ``z = W h + b``,
+  ``∂ℓ/∂W = (p − y) hᵀ`` and ``∂ℓ/∂b = p − y``, so the norm is
+  ``‖p − y‖ · sqrt(‖h‖² + 1)`` with no backward pass at all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example CE loss, [B] <- logits [B, C], labels [B]."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def el2n_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """EL2N score per example: L2 error of the softmax vector vs the one-hot target."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    err = probs - jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    return jnp.sqrt(jnp.sum(err * err, axis=-1))
+
+
+def grand_last_layer_from_logits(logits: jax.Array, features: jax.Array,
+                                 labels: jax.Array) -> jax.Array:
+    """Exact GraNd restricted to the classifier layer, no backward needed."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    err = probs - jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    err_sq = jnp.sum(err * err, axis=-1)
+    feat_sq = jnp.sum(features.astype(jnp.float32) ** 2, axis=-1)
+    return jnp.sqrt(err_sq * (feat_sq + 1.0))
+
+
+# ---------------------------------------------------------------------------
+# Jitted whole-batch score steps. Each returns (scores[B], indices[B], mask[B]).
+# ---------------------------------------------------------------------------
+
+def _forward(model, variables, images, *, eval_mode: bool, capture_features=False):
+    """Scoring forward pass. ``eval_mode=False`` reproduces the reference's accidental
+    train-mode scoring (BatchNorm normalizes by BATCH statistics instead of running
+    averages — ``get_scores_and_prune.py:8-20``, SURVEY §2.4.1) for A/B parity
+    studies; the stat updates themselves are discarded, never persisted."""
+    if eval_mode:
+        return model.apply(variables, images, train=False,
+                           capture_features=capture_features)
+    out, _ = model.apply(variables, images, train=True,
+                         capture_features=capture_features,
+                         mutable=["batch_stats"])
+    return out
+
+
+def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True):
+    """Forward-only EL2N over a (possibly mesh-sharded) batch.
+
+    Plain ``jit`` + sharded inputs: the computation is per-example, so GSPMD keeps
+    everything local to each device; no collectives are emitted.
+    """
+
+    @jax.jit
+    def step(variables, batch):
+        logits = _forward(model, variables, batch["image"], eval_mode=eval_mode)
+        scores = el2n_from_logits(logits, batch["label"]) * batch["mask"]
+        return scores
+
+    return step
+
+
+def make_grand_last_layer_step(model, mesh: Mesh | None = None,
+                               eval_mode: bool = True):
+    @jax.jit
+    def step(variables, batch):
+        logits, feats = _forward(model, variables, batch["image"],
+                                 eval_mode=eval_mode, capture_features=True)
+        scores = grand_last_layer_from_logits(logits, feats, batch["label"])
+        return scores * batch["mask"]
+
+    return step
+
+
+def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
+                    data_axis: str = "data", eval_mode: bool = True):
+    """Full GraNd: per-example gradient norm over ALL parameters.
+
+    Inside ``shard_map`` each device sees its local slice of the batch; the slice is
+    reshaped to ``[n_chunks, chunk]`` and ``lax.map`` runs a ``vmap`` of single-example
+    grads per chunk, reducing each gradient to its global norm immediately so at most
+    ``chunk`` gradient pytrees are live per device.
+    """
+
+    def per_example_norm(variables, image, label):
+        rest = {k: v for k, v in variables.items() if k != "params"}
+
+        def loss_fn(params):
+            logits = _forward(model, {"params": params, **rest}, image[None],
+                              eval_mode=eval_mode)
+            return cross_entropy(logits, label[None])[0]
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        return optax.global_norm(grads)
+
+    def local_scores(variables, image, label, mask):
+        n = image.shape[0]
+        c = min(chunk, n)
+        if n % c != 0:  # static shapes: pad local slice up to a chunk multiple
+            pad = c - n % c
+            image = jnp.concatenate([image, jnp.zeros((pad, *image.shape[1:]),
+                                                      image.dtype)])
+            label = jnp.concatenate([label, jnp.zeros((pad,), label.dtype)])
+        imgs = image.reshape(-1, c, *image.shape[1:])
+        labs = label.reshape(-1, c)
+        norms = jax.lax.map(
+            lambda xs: jax.vmap(partial(per_example_norm, variables))(*xs),
+            (imgs, labs))
+        return norms.reshape(-1)[:n] * mask
+
+    if mesh is None or mesh.size == 1:
+        @jax.jit
+        def step(variables, batch):
+            return local_scores(variables, batch["image"], batch["label"],
+                                batch["mask"])
+        return step
+
+    # check_vma=False: with VMA tracking on, jax.grad taken INSIDE the body w.r.t.
+    # the replicated (P()) params auto-inserts a psum over 'data' to keep the
+    # cotangent replicated — summing each position's per-example gradients ACROSS
+    # devices. These are per-example scores, not a data-parallel update: the body is
+    # fully local math and must stay that way.
+    sharded = jax.shard_map(
+        local_scores, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
+        out_specs=P(data_axis), check_vma=False)
+
+    @jax.jit
+    def step(variables, batch):
+        return sharded(variables, batch["image"], batch["label"], batch["mask"])
+
+    return step
+
+
+def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 32,
+                    eval_mode: bool = True):
+    """Factory keyed by config string (el2n | grand | grand_last_layer)."""
+    if method == "el2n":
+        return make_el2n_step(model, mesh, eval_mode=eval_mode)
+    if method == "grand":
+        return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode)
+    if method == "grand_last_layer":
+        return make_grand_last_layer_step(model, mesh, eval_mode=eval_mode)
+    raise ValueError(f"unknown score method {method!r}")

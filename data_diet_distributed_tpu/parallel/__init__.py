@@ -1,0 +1,9 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding, initialize_multihost,
+                   is_primary, make_mesh, param_specs, place_state, replicate,
+                   replicated)
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "batch_sharding", "initialize_multihost",
+    "is_primary", "make_mesh", "param_specs", "place_state", "replicate",
+    "replicated",
+]

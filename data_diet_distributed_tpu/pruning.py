@@ -1,0 +1,42 @@
+"""Keep-hardest subset selection (reference: ``get_scores_and_prune.py:22-27``).
+
+The reference sorts 50k Python tuples on the host and keeps the top
+``int((1 - sparsity) * N)`` by score, descending. Semantics preserved exactly —
+including the ``int()`` truncation — with deterministic tie-breaking (score desc, then
+global index asc; the reference's ``sorted`` on tuples had the same property by
+accident of tuple ordering) plus the paper's ``easiest`` / ``random`` ablation
+policies. Output is a sorted array of GLOBAL example ids, the only currency that
+crosses phase boundaries (never loader objects — SURVEY §2.4.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def num_kept(n: int, sparsity: float) -> int:
+    return int((1.0 - sparsity) * n)
+
+
+def select_indices(scores: np.ndarray, indices: np.ndarray, sparsity: float,
+                   keep: str = "hardest", seed: int = 0) -> np.ndarray:
+    """Return the global ids of the kept subset, sorted ascending.
+
+    ``scores[i]`` belongs to example ``indices[i]``; ``sparsity`` is the fraction
+    DROPPED. ``keep`` picks the policy: hardest (highest score — the Data Diet
+    default), easiest, or a score-blind random control.
+    """
+    if len(scores) != len(indices):
+        raise ValueError("scores and indices must align")
+    n = len(scores)
+    k = num_kept(n, sparsity)
+    if keep == "random":
+        chosen = np.random.default_rng(seed).permutation(n)[:k]
+    else:
+        key = -scores if keep == "hardest" else scores
+        # lexsort: primary=score direction, secondary=global index for determinism
+        order = np.lexsort((indices, key))
+        chosen = order[:k]
+    kept = np.sort(indices[chosen])
+    assert len(kept) == k  # reference keeps this invariant (get_scores_and_prune.py:29)
+    return kept

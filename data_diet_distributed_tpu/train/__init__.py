@@ -1,0 +1,9 @@
+from .loop import FitResult, evaluate, fit, run_datadiet, score_variables_for_seeds
+from .state import TrainState, create_train_state, make_optimizer
+from .steps import make_eval_step, make_train_step
+
+__all__ = [
+    "FitResult", "TrainState", "create_train_state", "evaluate", "fit",
+    "make_eval_step", "make_optimizer", "make_train_step", "run_datadiet",
+    "score_variables_for_seeds",
+]

@@ -1,0 +1,63 @@
+"""Train state: params + BatchNorm statistics + optimizer state + step counter.
+
+One schema for the whole framework — the reference carried two incompatible checkpoint
+layouts (``trainer/trainer.py:64-71`` vs ``ddp.py:116-123``) and never restored
+optimizer state; here the state object IS the checkpoint payload, so resume is exact.
+
+Optimizer matches the reference recipe (``train.py:76-77``): SGD + momentum + weight
+decay with cosine annealing — expressed as an optax chain with the schedule in
+steps (XLA-friendly: the schedule is traced arithmetic on the step counter, no Python
+control flow in the compiled program).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.training import train_state
+
+from ..config import Config
+from ..models import create_model
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = struct.field(default_factory=dict)
+
+    @property
+    def variables(self):
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+def make_optimizer(cfg: Config, steps_per_epoch: int) -> optax.GradientTransformation:
+    t_max_epochs = cfg.optim.cosine_t_max_epochs or cfg.train.num_epochs
+    schedule = optax.cosine_decay_schedule(
+        init_value=cfg.optim.lr,
+        decay_steps=max(1, t_max_epochs * steps_per_epoch))
+    parts = []
+    if cfg.optim.grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))
+    parts.append(optax.add_decayed_weights(cfg.optim.weight_decay))
+    parts.append(optax.sgd(schedule, momentum=cfg.optim.momentum,
+                           nesterov=cfg.optim.nesterov))
+    return optax.chain(*parts)
+
+
+def create_train_state(cfg: Config, rng: jax.Array, steps_per_epoch: int,
+                       sample_shape: tuple[int, ...] = (1, 32, 32, 3)) -> TrainState:
+    """Fresh model init + optimizer. The prune-then-retrain phase calls this again —
+    the reference also retrains from scratch after pruning (``train.py:71``)."""
+    model = create_model(cfg.model.arch, cfg.model.num_classes,
+                         cfg.train.half_precision)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        rng, jnp.zeros(sample_shape, jnp.float32), train=False)
+    tx = make_optimizer(cfg, steps_per_epoch)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        tx=tx,
+    )

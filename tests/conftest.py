@@ -1,0 +1,54 @@
+"""Test harness: 8 virtual CPU devices so mesh sharding, collective reductions, and
+multi-device scoring are exercised without TPU hardware (SURVEY §4's strategy — the
+reference itself has zero tests and could only test multi-GPU by owning 6 GPUs).
+
+Forcing the platform AFTER jax import (not only via env) matters: this image's
+sitecustomize registers an experimental TPU-tunnel backend at interpreter startup and
+overrides ``jax_platforms``; the config update below wins as long as no backend has
+been initialized yet.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from data_diet_distributed_tpu.config import load_config  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from data_diet_distributed_tpu.parallel.mesh import make_mesh
+    assert len(jax.devices()) == 8
+    return make_mesh(None)
+
+
+@pytest.fixture()
+def tiny_cfg(tmp_path):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256", "data.batch_size=64",
+        "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+    ])
+
+
+@pytest.fixture(scope="session")
+def tiny_ds():
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    return load_dataset("synthetic", synthetic_size=256, seed=0)
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)

@@ -1,0 +1,44 @@
+"""Config system: YAML load, dot-overrides, validation (SURVEY §5.6 — the reference
+had three duplicated loaders, dead keys, and no validation)."""
+
+import pytest
+
+from data_diet_distributed_tpu.config import Config, load_config, save_config, to_dict
+
+
+def test_defaults_validate():
+    cfg = load_config(None, [])
+    assert cfg.data.dataset == "cifar10"
+    assert cfg.model.num_classes == 10
+
+
+def test_dot_overrides_coerce_types():
+    cfg = load_config(None, [
+        "optim.lr=0.1", "train.resume=true", "score.seeds=[1,2,3]",
+        "prune.sparsity=0.3", "data.dataset=cifar100",
+    ])
+    assert cfg.optim.lr == 0.1 and cfg.train.resume is True
+    assert cfg.score.seeds == (1, 2, 3)
+    assert cfg.model.num_classes == 100  # synced from dataset
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        load_config(None, ["optim.learning_rate=0.1"])
+
+
+def test_invalid_values_rejected():
+    with pytest.raises(ValueError):
+        load_config(None, ["prune.sparsity=1.5"])
+    with pytest.raises(ValueError):
+        load_config(None, ["score.method=gradient"])
+    with pytest.raises(ValueError):
+        load_config(None, ["data.dataset=imagenet99"])
+
+
+def test_yaml_roundtrip(tmp_path):
+    cfg = load_config(None, ["optim.lr=0.25", "model.arch=resnet50"])
+    path = str(tmp_path / "cfg.yaml")
+    save_config(cfg, path)
+    cfg2 = load_config(path, [])
+    assert to_dict(cfg2) == to_dict(cfg)

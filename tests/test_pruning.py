@@ -1,0 +1,52 @@
+"""Pruning policy: exact reference semantics (int truncation, keep-hardest,
+descending) plus determinism and the ablation policies."""
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.pruning import num_kept, select_indices
+
+
+def test_num_kept_truncates_like_reference():
+    # reference: samples = int((1-sparsity)*N)  (get_scores_and_prune.py:22)
+    assert num_kept(50_000, 0.5) == 25_000
+    assert num_kept(7, 0.5) == 3          # int() truncation, not round
+    assert num_kept(10, 0.0) == 10
+
+
+def test_keep_hardest_top_fraction():
+    scores = np.array([0.1, 0.9, 0.5, 0.7, 0.3], np.float32)
+    idx = np.arange(5, dtype=np.int32)
+    kept = select_indices(scores, idx, sparsity=0.6)  # keep int(0.4*5)=2
+    assert np.array_equal(kept, [1, 3])  # two highest scores, sorted by id
+
+
+def test_keep_easiest_and_random():
+    scores = np.array([0.1, 0.9, 0.5, 0.7, 0.3], np.float32)
+    idx = np.arange(5, dtype=np.int32)
+    easiest = select_indices(scores, idx, sparsity=0.6, keep="easiest")
+    assert np.array_equal(easiest, [0, 4])
+    r1 = select_indices(scores, idx, sparsity=0.6, keep="random", seed=3)
+    r2 = select_indices(scores, idx, sparsity=0.6, keep="random", seed=3)
+    assert np.array_equal(r1, r2) and len(r1) == 2
+
+
+def test_tie_break_deterministic():
+    scores = np.ones(10, np.float32)
+    idx = np.arange(10, dtype=np.int32)[::-1].copy()  # ids 9..0
+    kept = select_indices(scores, idx, sparsity=0.5)
+    # all scores equal -> lowest global ids win deterministically
+    assert np.array_equal(kept, [0, 1, 2, 3, 4])
+
+
+def test_global_indices_respected():
+    # scores aligned with non-contiguous global ids (a pre-pruned subset)
+    ids = np.array([5, 17, 42, 99], np.int32)
+    scores = np.array([0.9, 0.1, 0.8, 0.2], np.float32)
+    kept = select_indices(scores, ids, sparsity=0.5)
+    assert np.array_equal(kept, [5, 42])
+
+
+def test_misaligned_inputs_rejected():
+    with pytest.raises(ValueError):
+        select_indices(np.ones(3), np.arange(4), 0.5)

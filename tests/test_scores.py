@@ -1,0 +1,121 @@
+"""Score kernels: EL2N against hand-computed values, GraNd against explicit
+per-example gradients, and the closed-form last-layer GraNd against autodiff."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.ops.scores import (cross_entropy, el2n_from_logits,
+                                                  grand_last_layer_from_logits,
+                                                  make_el2n_step, make_grand_step,
+                                                  make_score_step)
+
+
+def test_el2n_hand_computed():
+    # logits chosen so softmax is easy: uniform logits -> p = 1/C each
+    logits = jnp.zeros((1, 4))
+    labels = jnp.array([2])
+    # p = [.25]*4, err = p - onehot = [.25,.25,-.75,.25], ||err|| = sqrt(3*.0625+.5625)
+    expected = np.sqrt(3 * 0.0625 + 0.5625)
+    got = el2n_from_logits(logits, labels)
+    assert np.allclose(got, [expected], atol=1e-6)
+
+
+def test_el2n_matches_definition_random():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(16, 10)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, 16).astype(np.int32))
+    p = jax.nn.softmax(logits, axis=-1)
+    err = p - jax.nn.one_hot(labels, 10)
+    expected = jnp.linalg.norm(err, axis=-1)
+    assert np.allclose(el2n_from_logits(logits, labels), expected, atol=1e-6)
+
+
+def test_grand_last_layer_closed_form_matches_autodiff():
+    """For a pure linear classifier, last-layer GraNd IS full GraNd; the closed form
+    must equal the autodiff per-example gradient norm exactly."""
+    rng = np.random.default_rng(1)
+    feats = jnp.asarray(rng.normal(size=(8, 12)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 5, 8).astype(np.int32))
+    W = jnp.asarray(rng.normal(size=(12, 5)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(5,)).astype(np.float32))
+    logits = feats @ W + b
+
+    closed = grand_last_layer_from_logits(logits, feats, labels)
+
+    def per_example(params, f, y):
+        lg = f @ params["W"] + params["b"]
+        return cross_entropy(lg[None], y[None])[0]
+
+    def norm_one(f, y):
+        g = jax.grad(per_example)({"W": W, "b": b}, f, y)
+        return jnp.sqrt(sum(jnp.sum(v * v) for v in jax.tree.leaves(g)))
+
+    autodiff = jax.vmap(norm_one)(feats, labels)
+    assert np.allclose(closed, autodiff, rtol=1e-5, atol=1e-5)
+
+
+def test_full_grand_matches_explicit_loop():
+    model = create_model("tiny_cnn", 10)
+    x = jax.random.normal(jax.random.key(0), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(1), (8,), 0, 10)
+    variables = model.init(jax.random.key(2), x)
+    batch = {"image": x, "label": y, "mask": jnp.ones(8)}
+
+    step = make_grand_step(model, mesh=None, chunk=4)
+    got = step(variables, batch)
+
+    expected = []
+    for i in range(8):
+        def loss_fn(params):
+            logits = model.apply({"params": params,
+                                  "batch_stats": variables["batch_stats"]},
+                                 x[i:i + 1], train=False)
+            return cross_entropy(logits, y[i:i + 1])[0]
+        g = jax.grad(loss_fn)(variables["params"])
+        expected.append(float(jnp.sqrt(
+            sum(jnp.sum(v * v) for v in jax.tree.leaves(g)))))
+    assert np.allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_grand_chunk_padding():
+    # batch of 6 with chunk 4 forces internal padding; padded rows must not leak
+    model = create_model("tiny_cnn", 10)
+    x = jax.random.normal(jax.random.key(0), (6, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(1), (6,), 0, 10)
+    variables = model.init(jax.random.key(2), x)
+    batch = {"image": x, "label": y, "mask": jnp.ones(6)}
+    s_chunked = make_grand_step(model, None, chunk=4)(variables, batch)
+    s_whole = make_grand_step(model, None, chunk=6)(variables, batch)
+    assert np.allclose(s_chunked, s_whole, rtol=1e-5, atol=1e-6)
+
+
+def test_mask_zeroes_padding_scores():
+    model = create_model("tiny_cnn", 10)
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    y = jnp.zeros(4, jnp.int32)
+    variables = model.init(jax.random.key(2), x)
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+    for method in ("el2n", "grand", "grand_last_layer"):
+        step = make_score_step(model, method, None, chunk=2)
+        scores = np.asarray(step(variables, {"image": x, "label": y, "mask": mask}))
+        assert scores[2] == 0.0 and scores[3] == 0.0
+        assert scores[0] > 0.0
+
+
+def test_eval_mode_flag_changes_bn_semantics():
+    """eval_mode=False reproduces the reference's train-mode scoring (batch-stat
+    normalization, SURVEY §2.4.1): scores must differ from eval-mode scores."""
+    model = create_model("tiny_cnn", 10)
+    x = jax.random.normal(jax.random.key(0), (16, 32, 32, 3)) * 2.0 + 1.0
+    y = jax.random.randint(jax.random.key(1), (16,), 0, 10)
+    variables = model.init(jax.random.key(2), x)
+    batch = {"image": x, "label": y, "mask": jnp.ones(16)}
+    s_eval = np.asarray(make_el2n_step(model, eval_mode=True)(variables, batch))
+    s_train = np.asarray(make_el2n_step(model, eval_mode=False)(variables, batch))
+    assert not np.allclose(s_eval, s_train)
+    # and the pass must not have mutated the stored running stats
+    again = np.asarray(make_el2n_step(model, eval_mode=True)(variables, batch))
+    assert np.allclose(s_eval, again)
