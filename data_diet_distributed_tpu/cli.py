@@ -85,7 +85,8 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
                                method=cfg.score.method,
                                batch_size=cfg.score.batch_size,
                                sharder=sharder, chunk=cfg.score.grand_chunk,
-                               eval_mode=cfg.score.eval_mode)
+                               eval_mode=cfg.score.eval_mode,
+                               use_pallas=cfg.score.use_pallas)
         out = f"{cfg.train.checkpoint_dir}_scores.npz"
         np.savez(out, scores=scores, indices=train_ds.indices)
         logger.log("scores_saved", path=out, n=len(scores),

@@ -72,6 +72,8 @@ class ScoreConfig:
     # The reference accidentally scores in train mode with grads on (§2.4.1 of SURVEY.md);
     # we score in eval mode by default but keep the switch for A/B parity studies.
     eval_mode: bool = True
+    # Fused Pallas score kernels: None = auto (on for TPU backends, off elsewhere).
+    use_pallas: bool | None = None
 
 
 @dataclass

@@ -131,20 +131,20 @@ class ResNet(nn.Module):
 
 
 def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, dtype=dtype)
+    return ResNet((2, 2, 2, 2), BasicBlock, num_classes=num_classes, dtype=dtype)
 
 
 def ResNet34(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, dtype=dtype)
+    return ResNet((3, 4, 6, 3), BasicBlock, num_classes=num_classes, dtype=dtype)
 
 
 def ResNet50(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+    return ResNet((3, 4, 6, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)
 
 
 def ResNet101(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+    return ResNet((3, 4, 23, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)
 
 
 def ResNet152(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes, dtype=dtype)
+    return ResNet((3, 8, 36, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,123 @@
+"""Pallas TPU kernels for the scoring hot path.
+
+Two fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
+
+* ``el2n_pallas`` — fused ``softmax -> subtract one-hot -> row L2 norm -> mask``
+  over logits. One VMEM round-trip instead of four HBM-materialized intermediates.
+* ``grand_last_layer_pallas`` — the closed-form last-layer GraNd
+  (``‖p − y‖ · sqrt(‖h‖² + 1)``) fused WITH the classifier matmul: features hit the
+  MXU against the classifier weights and the score math runs on the VPU before
+  logits ever leave VMEM. The model's own Dense head output goes unused and is
+  dead-code-eliminated under jit, so the classifier matmul happens exactly once.
+
+Both kernels tile the batch dimension (``TILE_B`` rows per grid step, fp32-aligned)
+and keep the class dimension whole (Mosaic pads the lane dimension internally).
+Padded batch rows carry ``mask == 0`` and score 0. On non-TPU backends the kernels
+run in interpreter mode, so every test exercises the same code path CI runs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_B = 256  # batch rows per grid step; multiple of the fp32 sublane tile (8)
+
+
+def _auto_interpret(interpret: bool | None) -> bool:
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+def _tile_for(batch: int) -> int:
+    """Largest fp32-sublane-aligned tile <= TILE_B covering the batch."""
+    rounded = (batch + 7) // 8 * 8
+    return min(TILE_B, rounded)
+
+
+def _pad_batch(arrs, batch: int, tile: int):
+    pad = (-batch) % tile
+    if pad == 0:
+        return arrs, batch + pad
+    return [jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1)) for a in arrs], batch + pad
+
+
+def _onehot_err(logits, labels_col):
+    """softmax(logits) − onehot(labels): the shared EL2N/GraNd error vector."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, probs.shape, 1)
+    return probs - (cols == labels_col).astype(jnp.float32)
+
+
+def _el2n_kernel(logits_ref, labels_ref, mask_ref, out_ref):
+    err = _onehot_err(logits_ref[:], labels_ref[:])
+    out_ref[:] = jnp.sqrt(jnp.sum(err * err, axis=-1, keepdims=True)) * mask_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def el2n_pallas(logits: jax.Array, labels: jax.Array, mask: jax.Array,
+                interpret: bool | None = None) -> jax.Array:
+    """EL2N scores [B] from logits [B, C]; fused single-pass kernel."""
+    b, c = logits.shape
+    tile = _tile_for(b)
+    (logits, labels2, mask2), b_pad = _pad_batch(
+        [logits.astype(jnp.float32), labels.astype(jnp.int32)[:, None],
+         mask.astype(jnp.float32)[:, None]], b, tile)
+    out = pl.pallas_call(
+        _el2n_kernel,
+        grid=(b_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, c), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        interpret=_auto_interpret(interpret),
+    )(logits, labels2, mask2)
+    return out[:b, 0]
+
+
+def _gll_kernel(feats_ref, w_ref, b_ref, labels_ref, mask_ref, out_ref):
+    feats = feats_ref[:]
+    logits = jnp.dot(feats, w_ref[:],
+                     preferred_element_type=jnp.float32) + b_ref[:]
+    err = _onehot_err(logits, labels_ref[:])
+    err_sq = jnp.sum(err * err, axis=-1, keepdims=True)
+    feat_sq = jnp.sum(feats * feats, axis=-1, keepdims=True)
+    out_ref[:] = jnp.sqrt(err_sq * (feat_sq + 1.0)) * mask_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def grand_last_layer_pallas(features: jax.Array, kernel: jax.Array,
+                            bias: jax.Array, labels: jax.Array, mask: jax.Array,
+                            interpret: bool | None = None) -> jax.Array:
+    """Last-layer GraNd [B] from features [B, F] and classifier (kernel [F, C],
+    bias [C]); classifier matmul and score math fused in one kernel."""
+    b, f = features.shape
+    c = kernel.shape[1]
+    tile = _tile_for(b)
+    (feats, labels2, mask2), b_pad = _pad_batch(
+        [features.astype(jnp.float32), labels.astype(jnp.int32)[:, None],
+         mask.astype(jnp.float32)[:, None]], b, tile)
+    out = pl.pallas_call(
+        _gll_kernel,
+        grid=(b_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        interpret=_auto_interpret(interpret),
+    )(feats, kernel.astype(jnp.float32),
+      bias.astype(jnp.float32)[None, :], labels2, mask2)
+    return out[:b, 0]

@@ -7,25 +7,32 @@ TPU-first design decisions:
 
 * scoring runs in **eval mode** (frozen BatchNorm statistics) — the reference
   accidentally scored in train mode, mutating running stats (SURVEY §2.4.1);
-* the dataset pass is sharded over the mesh's ``data`` axis — every device scores its
-  shard concurrently, where the reference scored the whole set on one GPU
-  (``ddp.py:56``);
+* the dataset pass is sharded over the mesh's ``data`` axis via ``shard_map`` — every
+  device scores its shard concurrently, where the reference scored the whole set on
+  one GPU (``ddp.py:56``);
 * full GraNd is a ``vmap(grad)`` per-example backward, chunked with ``lax.map`` inside
   ``shard_map`` so peak memory is ``chunk`` gradients per device while the MXU still
   sees batched convs;
 * last-layer GraNd is closed-form — for a linear classifier ``z = W h + b``,
   ``∂ℓ/∂W = (p − y) hᵀ`` and ``∂ℓ/∂b = p − y``, so the norm is
-  ``‖p − y‖ · sqrt(‖h‖² + 1)`` with no backward pass at all.
+  ``‖p − y‖ · sqrt(‖h‖² + 1)`` with no backward pass at all;
+* the EL2N / last-layer-GraNd epilogues have fused Pallas kernels
+  (``pallas_kernels.py``), selected by ``use_pallas`` (auto-on for TPU backends).
+  ``pallas_call`` is not GSPMD-partitionable, which is one more reason the mesh
+  path uses ``shard_map``: each device invokes the kernel on its local shard.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .pallas_kernels import el2n_pallas, grand_last_layer_pallas
 
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -50,10 +57,6 @@ def grand_last_layer_from_logits(logits: jax.Array, features: jax.Array,
     return jnp.sqrt(err_sq * (feat_sq + 1.0))
 
 
-# ---------------------------------------------------------------------------
-# Jitted whole-batch score steps. Each returns (scores[B], indices[B], mask[B]).
-# ---------------------------------------------------------------------------
-
 def _forward(model, variables, images, *, eval_mode: bool, capture_features=False):
     """Scoring forward pass. ``eval_mode=False`` reproduces the reference's accidental
     train-mode scoring (BatchNorm normalizes by BATCH statistics instead of running
@@ -68,36 +71,81 @@ def _forward(model, variables, images, *, eval_mode: bool, capture_features=Fals
     return out
 
 
-def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True):
-    """Forward-only EL2N over a (possibly mesh-sharded) batch.
+def _wrap(local_scores, mesh: Mesh | None, data_axis: str = "data"):
+    """Lift a per-device ``(variables, image, label, mask) -> scores`` function to a
+    jitted whole-batch step, sharded over ``data`` when a multi-device mesh is given.
 
-    Plain ``jit`` + sharded inputs: the computation is per-example, so GSPMD keeps
-    everything local to each device; no collectives are emitted.
+    check_vma=False on the shard_map: with VMA tracking on, ``jax.grad`` taken INSIDE
+    the body w.r.t. the replicated (P()) params auto-inserts a psum over 'data' to
+    keep the cotangent replicated — summing each position's per-example gradients
+    ACROSS devices. These are per-example scores, not a data-parallel update: the
+    body is fully local math and must stay that way. (It also lets the body invoke
+    Pallas kernels, which GSPMD could not partition.)
     """
+    if mesh is None or mesh.size == 1:
+        @jax.jit
+        def step(variables, batch):
+            return local_scores(variables, batch["image"], batch["label"],
+                                batch["mask"])
+        return step
+
+    sharded = jax.shard_map(
+        local_scores, mesh=mesh,
+        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
+        out_specs=P(data_axis), check_vma=False)
 
     @jax.jit
     def step(variables, batch):
-        logits = _forward(model, variables, batch["image"], eval_mode=eval_mode)
-        scores = el2n_from_logits(logits, batch["label"]) * batch["mask"]
-        return scores
+        return sharded(variables, batch["image"], batch["label"], batch["mask"])
 
     return step
 
 
+def resolve_use_pallas(use_pallas: bool | None) -> bool:
+    """None -> auto: fused kernels on TPU, plain XLA elsewhere (the kernels still
+    run everywhere via interpret mode, but interpreted kernels are slower than XLA)."""
+    return jax.default_backend() == "tpu" if use_pallas is None else use_pallas
+
+
+@functools.cache
+def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
+                   use_pallas: bool | None = False):
+    """Forward-only EL2N over a (possibly mesh-sharded) batch."""
+    use_pallas = resolve_use_pallas(use_pallas)
+
+    def local_scores(variables, image, label, mask):
+        logits = _forward(model, variables, image, eval_mode=eval_mode)
+        if use_pallas:
+            return el2n_pallas(logits, label, mask)
+        return el2n_from_logits(logits, label) * mask
+
+    return _wrap(local_scores, mesh)
+
+
+@functools.cache
 def make_grand_last_layer_step(model, mesh: Mesh | None = None,
-                               eval_mode: bool = True):
-    @jax.jit
-    def step(variables, batch):
-        logits, feats = _forward(model, variables, batch["image"],
+                               eval_mode: bool = True,
+                               use_pallas: bool | None = False):
+    use_pallas = resolve_use_pallas(use_pallas)
+
+    def local_scores(variables, image, label, mask):
+        logits, feats = _forward(model, variables, image,
                                  eval_mode=eval_mode, capture_features=True)
-        scores = grand_last_layer_from_logits(logits, feats, batch["label"])
-        return scores * batch["mask"]
+        if use_pallas:
+            # The fused kernel redoes the classifier matmul in VMEM; the model's
+            # logits are unused here and DCE'd, so the matmul still runs once.
+            head = variables["params"]["classifier"]
+            return grand_last_layer_pallas(feats, head["kernel"], head["bias"],
+                                           label, mask)
+        return grand_last_layer_from_logits(logits, feats, label) * mask
 
-    return step
+    return _wrap(local_scores, mesh)
 
 
+@functools.cache
 def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
-                    data_axis: str = "data", eval_mode: bool = True):
+                    data_axis: str = "data", eval_mode: bool = True,
+                    use_pallas: bool | None = False):
     """Full GraNd: per-example gradient norm over ALL parameters.
 
     Inside ``shard_map`` each device sees its local slice of the batch; the slice is
@@ -132,37 +180,20 @@ def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
             (imgs, labs))
         return norms.reshape(-1)[:n] * mask
 
-    if mesh is None or mesh.size == 1:
-        @jax.jit
-        def step(variables, batch):
-            return local_scores(variables, batch["image"], batch["label"],
-                                batch["mask"])
-        return step
-
-    # check_vma=False: with VMA tracking on, jax.grad taken INSIDE the body w.r.t.
-    # the replicated (P()) params auto-inserts a psum over 'data' to keep the
-    # cotangent replicated — summing each position's per-example gradients ACROSS
-    # devices. These are per-example scores, not a data-parallel update: the body is
-    # fully local math and must stay that way.
-    sharded = jax.shard_map(
-        local_scores, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=P(data_axis), check_vma=False)
-
-    @jax.jit
-    def step(variables, batch):
-        return sharded(variables, batch["image"], batch["label"], batch["mask"])
-
-    return step
+    return _wrap(local_scores, mesh, data_axis)
 
 
+@functools.cache
 def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 32,
-                    eval_mode: bool = True):
+                    eval_mode: bool = True, use_pallas: bool | None = False):
     """Factory keyed by config string (el2n | grand | grand_last_layer)."""
     if method == "el2n":
-        return make_el2n_step(model, mesh, eval_mode=eval_mode)
+        return make_el2n_step(model, mesh, eval_mode=eval_mode,
+                              use_pallas=use_pallas)
     if method == "grand":
-        return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode)
+        return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode,
+                               use_pallas=use_pallas)
     if method == "grand_last_layer":
-        return make_grand_last_layer_step(model, mesh, eval_mode=eval_mode)
+        return make_grand_last_layer_step(model, mesh, eval_mode=eval_mode,
+                                          use_pallas=use_pallas)
     raise ValueError(f"unknown score method {method!r}")

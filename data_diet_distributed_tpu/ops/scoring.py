@@ -31,7 +31,8 @@ def _to_host(x: jax.Array) -> np.ndarray:
 def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   method: str = "el2n", batch_size: int = 512,
                   sharder: BatchSharder | None = None, chunk: int = 32,
-                  eval_mode: bool = True, score_step=None) -> np.ndarray:
+                  eval_mode: bool = True, use_pallas: bool | None = False,
+                  score_step=None) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 
     ``variables_seeds`` is a sequence of model variable pytrees (one per scoring seed);
@@ -40,7 +41,7 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     mesh = sharder.mesh if sharder is not None else None
     if score_step is None:
         score_step = make_score_step(model, method, mesh, chunk=chunk,
-                                     eval_mode=eval_mode)
+                                     eval_mode=eval_mode, use_pallas=use_pallas)
     if sharder is not None:
         batch_size = sharder.global_batch_size_for(batch_size)
 

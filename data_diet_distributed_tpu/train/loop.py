@@ -216,7 +216,8 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
                                method=cfg.score.method,
                                batch_size=cfg.score.batch_size,
                                sharder=sharder, chunk=cfg.score.grand_chunk,
-                               eval_mode=cfg.score.eval_mode)
+                               eval_mode=cfg.score.eval_mode,
+                               use_pallas=cfg.score.use_pallas)
         score_s = time.perf_counter() - t_score
         kept = select_indices(scores, train_ds.indices, cfg.prune.sparsity,
                               keep=cfg.prune.keep, seed=cfg.train.seed)

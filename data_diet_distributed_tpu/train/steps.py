@@ -20,6 +20,8 @@ compiled XLA program per step:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -27,6 +29,10 @@ from ..ops.scores import cross_entropy
 from .state import TrainState
 
 
+# functools.cache: Flax modules are frozen dataclasses (hashable by config), so the
+# same model config returns the SAME jitted step — repeated fits (multi-seed scoring
+# pretrains 10 models) hit the jit cache instead of recompiling per seed.
+@functools.cache
 def make_train_step(model):
     def train_step(state: TrainState, batch):
         mask = batch["mask"]
@@ -49,6 +55,7 @@ def make_train_step(model):
     return jax.jit(train_step, donate_argnums=(0,))
 
 
+@functools.cache
 def make_eval_step(model):
     def eval_step(state: TrainState, batch):
         mask = batch["mask"]
