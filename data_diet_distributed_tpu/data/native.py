@@ -1,0 +1,156 @@
+"""ctypes bridge to the native host data engine (``native/datadiet_native.cpp``).
+
+Loading is lazy and failure-tolerant: if the shared library is absent the loader
+tries one ``g++`` build (sub-second), and if that fails every entry point falls
+back to the NumPy implementation — the framework never *requires* the native path,
+it just gets a faster host loop when available (and ``DATADIET_NO_NATIVE=1``
+force-disables it for A/B benchmarking).
+
+``BatchAssembler`` adds output-buffer reuse: one float32 batch buffer allocated per
+(batch_size, row_shape) and overwritten in place each step, so steady-state batch
+assembly does zero host allocations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB_NAME = "libdatadiet_native.so"
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "datadiet_native.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), _LIB_NAME)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             src, "-o", _LIB_PATH],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building on first use) the native library; None if unavailable."""
+    global _lib, _tried
+    if os.environ.get("DATADIET_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.dd_abi_version.restype = ctypes.c_int32
+            if lib.dd_abi_version() != 1:
+                return None
+            lib.dd_gather_f32.argtypes = [
+                _f32p, ctypes.c_int64, _i64p, ctypes.c_int64, ctypes.c_int64,
+                _f32p]
+            lib.dd_gather_i32.argtypes = [
+                _i32p, _i64p, ctypes.c_int64, ctypes.c_int64, _i32p]
+            lib.dd_gather_normalize_u8.argtypes = [
+                _u8p, ctypes.c_int64, _i64p, ctypes.c_int64, ctypes.c_int64,
+                _f32p, _f32p, ctypes.c_int64, _f32p]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class BatchAssembler:
+    """Gather-and-pad batch assembly with native fast path and buffer reuse.
+
+    ``assemble(images, labels, indices, take, batch_size)`` returns the
+    ``(image, label, index, mask)`` arrays for ``rows = take`` padded to
+    ``batch_size``.
+
+    ``reuse=True`` keeps one float image buffer and overwrites it per call —
+    zero steady-state allocations, but ONLY safe when the previous batch has been
+    fully consumed (``jax.device_put`` transfers are async and may alias host
+    memory on CPU backends, so the training pipeline uses ``reuse=False``).
+    """
+
+    def __init__(self, reuse: bool = False):
+        self.reuse = reuse
+        self._img_buf: np.ndarray | None = None
+
+    def assemble(self, images: np.ndarray, labels: np.ndarray,
+                 indices: np.ndarray, take: np.ndarray, batch_size: int):
+        n_take = len(take)
+        row_shape = images.shape[1:]
+        lib = load()
+
+        mask = np.zeros(batch_size, np.float32)
+        mask[:n_take] = 1.0
+
+        if lib is not None and images.dtype == np.float32:
+            if (not self.reuse or self._img_buf is None
+                    or self._img_buf.shape != (batch_size, *row_shape)):
+                self._img_buf = np.empty((batch_size, *row_shape), np.float32)
+            rows = np.ascontiguousarray(take, np.int64)
+            row_elems = int(np.prod(row_shape))
+            lib.dd_gather_f32(images, row_elems, rows, n_take, batch_size,
+                              self._img_buf)
+            label_out = np.empty(batch_size, np.int32)
+            index_out = np.empty(batch_size, np.int32)
+            lib.dd_gather_i32(np.ascontiguousarray(labels, np.int32), rows,
+                              n_take, batch_size, label_out)
+            lib.dd_gather_i32(np.ascontiguousarray(indices, np.int32), rows,
+                              n_take, batch_size, index_out)
+            return self._img_buf, label_out, index_out, mask
+
+        # NumPy fallback (and the reference implementation for tests).
+        pad = batch_size - n_take
+        full = np.concatenate([take, np.zeros(pad, np.int64)]) if pad else take
+        image = images[full]
+        label = labels[full].copy()
+        index = indices[full].copy()
+        if pad:
+            label[n_take:] = 0
+            index[n_take:] = 0
+        return image, label, index, mask
+
+
+def gather_normalize_u8(images_u8: np.ndarray, take: np.ndarray,
+                        mean: np.ndarray, std: np.ndarray,
+                        batch_size: int) -> np.ndarray | None:
+    """Fused gather + uint8->normalized-float via the native engine; None if the
+    native library is unavailable (caller falls back to numpy)."""
+    lib = load()
+    if lib is None:
+        return None
+    row_shape = images_u8.shape[1:]
+    out = np.empty((batch_size, *row_shape), np.float32)
+    lib.dd_gather_normalize_u8(
+        np.ascontiguousarray(images_u8), int(np.prod(row_shape)),
+        np.ascontiguousarray(take, np.int64), len(take), batch_size,
+        np.ascontiguousarray(mean, np.float32),
+        np.ascontiguousarray(1.0 / std, np.float32),
+        images_u8.shape[-1], out)
+    return out

@@ -34,28 +34,25 @@ def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
 
 
 def iterate_batches(ds: ArrayDataset, batch_size: int, *, shuffle: bool = False,
-                    seed: int = 0, epoch: int = 0,
-                    pad_to_full: bool = True) -> Iterator[Batch]:
+                    seed: int = 0, epoch: int = 0, pad_to_full: bool = True,
+                    assembler: "BatchAssembler | None" = None) -> Iterator[Batch]:
     """Yield padded, masked global batches as host numpy dicts.
 
     The final partial batch is padded by repeating row 0 with ``mask=0``; reductions
-    must multiply by ``mask`` (all built-in steps here do).
+    must multiply by ``mask`` (all built-in steps here do). Assembly (gather + pad)
+    goes through the native C++ engine when available (``data/native.py``), with a
+    NumPy fallback.
     """
+    from .native import BatchAssembler
+    asm = assembler or BatchAssembler()
     n = len(ds)
     order = epoch_permutation(n, seed, epoch) if shuffle else np.arange(n)
     for start in range(0, n, batch_size):
         take = order[start:start + batch_size]
-        pad = batch_size - len(take) if pad_to_full else 0
-        mask = np.ones(len(take) + pad, np.float32)
-        if pad:
-            mask[len(take):] = 0.0
-            take = np.concatenate([take, np.zeros(pad, np.int64)])
-        yield {
-            "image": ds.images[take],
-            "label": ds.labels[take],
-            "index": ds.indices[take],
-            "mask": mask,
-        }
+        n_out = batch_size if pad_to_full else len(take)
+        image, label, index, mask = asm.assemble(
+            ds.images, ds.labels, ds.indices, take.astype(np.int64), n_out)
+        yield {"image": image, "label": label, "index": index, "mask": mask}
 
 
 def num_batches(n: int, batch_size: int) -> int:

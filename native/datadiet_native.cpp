@@ -1,0 +1,91 @@
+// Native host-side data engine for data_diet_distributed_tpu.
+//
+// The reference gets its native data path from torch's C++ DataLoader workers
+// (SURVEY.md §2: its only native code lives in dependencies). Here the equivalent
+// host hot path — assembling a device batch by gathering rows of the in-RAM
+// dataset, optionally fusing uint8 -> normalized-float conversion, and padding to
+// the global batch size — is a small C++ library driven from Python via ctypes.
+//
+// Functions are exported with C linkage; all memory is caller-owned numpy buffers,
+// so there is no allocation or ownership transfer across the boundary. Threading
+// splits the row range across hardware threads for large batches.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Spawn up to hardware_concurrency workers over [0, n) in contiguous spans.
+template <typename Fn>
+void parallel_rows(int64_t n, Fn&& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t workers = std::max<int64_t>(1, std::min<int64_t>(hw, n / 1024));
+  if (workers <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  int64_t span = (n + workers - 1) / workers;
+  for (int64_t w = 0; w < workers; ++w) {
+    int64_t lo = w * span;
+    int64_t hi = std::min(n, lo + span);
+    if (lo >= hi) break;
+    pool.emplace_back([=, &fn] { fn(lo, hi); });
+  }
+  for (auto& t : pool) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather float32 rows: out[i, :] = src[rows[i], :]. Rows beyond n_take (padding)
+// are copied from row 0 — the caller masks them out.
+void dd_gather_f32(const float* src, int64_t row_elems, const int64_t* rows,
+                   int64_t n_take, int64_t n_out, float* out) {
+  parallel_rows(n_out, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t r = i < n_take ? rows[i] : 0;  // padding rows gather row 0
+      std::memcpy(out + i * row_elems, src + r * row_elems,
+                  sizeof(float) * row_elems);
+    }
+  });
+}
+
+// Gather int32 scalars with zero padding: out[i] = i < n_take ? src[rows[i]] : 0.
+void dd_gather_i32(const int32_t* src, const int64_t* rows, int64_t n_take,
+                   int64_t n_out, int32_t* out) {
+  for (int64_t i = 0; i < n_out; ++i) {
+    out[i] = i < n_take ? src[rows[i]] : 0;
+  }
+}
+
+// Fused gather + uint8 -> normalized float32: for NHWC images with C channels,
+// out[i, p, c] = (src[rows[i], p, c] / 255 - mean[c]) / std[c].
+// inv_std must be precomputed as 1/std (one divide per channel on the host side).
+void dd_gather_normalize_u8(const uint8_t* src, int64_t row_elems,
+                            const int64_t* rows, int64_t n_take, int64_t n_out,
+                            const float* mean, const float* inv_std,
+                            int64_t channels, float* out) {
+  parallel_rows(n_out, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t r = i < n_take ? rows[i] : 0;
+      const uint8_t* in_row = src + r * row_elems;
+      float* out_row = out + i * row_elems;
+      for (int64_t p = 0; p < row_elems; ++p) {
+        int64_t c = p % channels;
+        out_row[p] = (static_cast<float>(in_row[p]) * (1.0f / 255.0f) - mean[c])
+                     * inv_std[c];
+      }
+    }
+  });
+}
+
+// Library self-identification for the ctypes loader's sanity check.
+int32_t dd_abi_version() { return 1; }
+
+}  // extern "C"
