@@ -59,24 +59,19 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
         from .train.loop import run_datadiet
         run_datadiet(cfg, logger)
     elif command == "train":
-        from .data.datasets import load_dataset
-        from .train.loop import fit
-        train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
-                                         cfg.data.synthetic_size,
-                                         seed=cfg.train.seed)
-        fit(cfg, train_ds, test_ds, logger=logger,
-            checkpoint_dir=cfg.train.checkpoint_dir, tag="dense")
+        from .train.loop import fit_with_recovery, load_data_for
+        train_ds, test_ds = load_data_for(cfg)
+        fit_with_recovery(cfg, train_ds, test_ds, logger=logger,
+                          checkpoint_dir=cfg.train.checkpoint_dir, tag="dense")
     elif command == "score":
-        from .data.datasets import load_dataset
         from .data.pipeline import BatchSharder
         from .models import create_model
         from .ops.scoring import score_dataset
         from .parallel.mesh import make_mesh
-        from .train.loop import score_variables_for_seeds
+        from .train.loop import load_data_for, score_variables_for_seeds
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
-        train_ds, _ = load_dataset(cfg.data.dataset, cfg.data.data_dir,
-                                   cfg.data.synthetic_size, seed=cfg.train.seed)
+        train_ds, _ = load_data_for(cfg)
         seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
                                                sharder=sharder, logger=logger)
         model = create_model(cfg.model.arch, cfg.model.num_classes,

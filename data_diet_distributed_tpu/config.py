@@ -21,16 +21,20 @@ import yaml
 class DataConfig:
     """Dataset selection and host-side pipeline knobs (reference: ``data/loader.py``)."""
 
-    dataset: str = "cifar10"          # cifar10 | cifar100 | synthetic
-    data_dir: str = "./data"          # where CIFAR python batches live (no download here)
+    # cifar10 | cifar100 | synthetic | synthetic_imagenet | npz (bring-your-own
+    # train.npz/test.npz in data_dir — the ImageNet-subset path)
+    dataset: str = "cifar10"
+    data_dir: str = "./data"          # where CIFAR python batches / npz files live
     batch_size: int = 128             # global batch size (reference: config.yaml:7)
     eval_batch_size: int = 500        # reference hardcodes 100 (data/loader.py:41)
-    synthetic_size: int = 2048        # train-set size when dataset == "synthetic"
+    synthetic_size: int = 2048        # train-set size for the synthetic datasets
     shuffle_each_epoch: bool = True   # reference bug 2.4.6: DDP reshuffle never happened
 
     @property
-    def num_classes(self) -> int:
-        return {"cifar10": 10, "cifar100": 100, "synthetic": 10}[self.dataset]
+    def num_classes(self) -> int | None:
+        """Class count when statically known; None for npz (inferred at load)."""
+        return {"cifar10": 10, "cifar100": 100, "synthetic": 10,
+                "synthetic_imagenet": 100, "npz": None}[self.dataset]
 
 
 @dataclass
@@ -96,6 +100,10 @@ class TrainConfig:
     checkpoint_dir: str = "./checkpoints"
     keep_checkpoints: int = 20
     resume: bool = False           # true resume (params+opt_state+step); reference had none
+    # Restart-based failure recovery (SURVEY §5.3: the reference has none — a crashed
+    # worker fails the whole job): on an exception mid-fit, re-enter from the latest
+    # checkpoint up to this many times.
+    auto_resume_retries: int = 0
     half_precision: bool = True    # bfloat16 compute on TPU, fp32 params
     log_every_steps: int = 50
 
@@ -138,7 +146,8 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
 
     def validate(self) -> "Config":
-        if self.data.dataset not in ("cifar10", "cifar100", "synthetic"):
+        if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
+                                     "synthetic_imagenet", "npz"):
             raise ValueError(f"unknown dataset {self.data.dataset!r}")
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
@@ -146,7 +155,8 @@ class Config:
             raise ValueError(f"unknown score method {self.score.method!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):
             raise ValueError(f"unknown keep policy {self.prune.keep!r}")
-        if self.model.num_classes != self.data.num_classes:
+        if (self.data.num_classes is not None
+                and self.model.num_classes != self.data.num_classes):
             # keep them in sync automatically rather than erroring
             self.model.num_classes = self.data.num_classes
         if self.data.batch_size <= 0 or self.train.num_epochs < 0:

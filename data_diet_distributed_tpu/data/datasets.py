@@ -109,8 +109,9 @@ def _normalize(x_uint8: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.nda
     return ((x_uint8.astype(np.float32) / 255.0) - mean) / std
 
 
-def _synthetic(size: int, num_classes: int, seed: int, split: str):
-    """Deterministic class-structured fake CIFAR: each class gets a fixed template plus
+def _synthetic(size: int, num_classes: int, seed: int, split: str,
+               image_size: int = 32):
+    """Deterministic class-structured fake data: each class gets a fixed template plus
     noise, so models can actually learn and pruning scores are non-degenerate. The
     templates depend only on ``seed`` — train and test splits share them (different
     noise), so generalization is measurable."""
@@ -119,15 +120,46 @@ def _synthetic(size: int, num_classes: int, seed: int, split: str):
     # a per-channel signature (survives global average pooling, so GAP-headed conv
     # nets separate classes within a few optimizer steps).
     templates = template_rng.normal(
-        0.0, 0.5, size=(num_classes, 32, 32, 3)).astype(np.float32)
+        0.0, 0.5, size=(num_classes, image_size, image_size, 3)).astype(np.float32)
     channel_sig = template_rng.normal(
         0.0, 1.0, size=(num_classes, 1, 1, 3)).astype(np.float32)
     rng = np.random.default_rng(
         np.random.SeedSequence([seed, 1 if split == "train" else 2]))
     labels = rng.integers(0, num_classes, size=size).astype(np.int32)
-    noise = rng.normal(0.0, 0.4, size=(size, 32, 32, 3)).astype(np.float32)
+    noise = rng.normal(
+        0.0, 0.4, size=(size, image_size, image_size, 3)).astype(np.float32)
     images = templates[labels] + channel_sig[labels] + noise
     return images, labels
+
+
+def _load_npz(data_dir: str):
+    """Bring-your-own-data path: ``{data_dir}/train.npz`` and ``test.npz`` with keys
+    ``images`` (NHWC uint8 or float32) and ``labels``. uint8 images are normalized
+    with per-channel statistics computed from the train split (or explicit ``mean`` /
+    ``std`` keys in train.npz). This is how real ImageNet subsets (BASELINE config 5)
+    are fed without any torchvision/tfds dependency."""
+    paths = {s: os.path.join(data_dir, f"{s}.npz") for s in ("train", "test")}
+    for p in paths.values():
+        if not os.path.exists(p):
+            raise FileNotFoundError(f"npz dataset missing {p}")
+    train = np.load(paths["train"])
+    test = np.load(paths["test"])
+
+    def stats():
+        if "mean" in train and "std" in train:
+            return (np.asarray(train["mean"], np.float32),
+                    np.asarray(train["std"], np.float32))
+        x = train["images"].astype(np.float32) / 255.0
+        return x.mean(axis=(0, 1, 2)), x.std(axis=(0, 1, 2)) + 1e-8
+
+    def prep(d):
+        x = d["images"]
+        if x.dtype == np.uint8:
+            mean, std = stats()
+            x = _normalize(x, mean, std)
+        return x.astype(np.float32), np.asarray(d["labels"], np.int32)
+
+    return prep(train), prep(test)
 
 
 def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2048,
@@ -137,6 +169,16 @@ def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2
         train_x, train_y = _synthetic(synthetic_size, 10, seed, "train")
         test_x, test_y = _synthetic(max(synthetic_size // 4, 64), 10, seed, "test")
         num_classes = 10
+    elif dataset == "synthetic_imagenet":
+        # ImageNet-geometry stand-in: 96x96, 100 classes. Exercises the ResNet-50
+        # large-input path (BASELINE config 5) without the real dataset.
+        train_x, train_y = _synthetic(synthetic_size, 100, seed, "train", 96)
+        test_x, test_y = _synthetic(max(synthetic_size // 4, 100), 100, seed,
+                                    "test", 96)
+        num_classes = 100
+    elif dataset == "npz":
+        (train_x, train_y), (test_x, test_y) = _load_npz(data_dir)
+        num_classes = int(train_y.max()) + 1
     elif dataset in ("cifar10", "cifar100"):
         (train_raw, train_y), (test_raw, test_y) = _load_cifar_batches(data_dir, dataset)
         mean, std = ((CIFAR10_MEAN, CIFAR10_STD) if dataset == "cifar10"

@@ -154,6 +154,45 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     return result
 
 
+def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
+                      test_ds: ArrayDataset | None = None, *,
+                      checkpoint_dir: str | None = None,
+                      logger: MetricsLogger | None = None, **kwargs) -> FitResult:
+    """``fit`` with restart-based failure recovery (SURVEY §5.3 — absent from the
+    reference, whose only supervision was ``mp.spawn(join=True)``).
+
+    On an exception, re-enters training from the latest checkpoint, up to
+    ``train.auto_resume_retries`` times. Requires a checkpoint_dir; with retries=0
+    this is exactly ``fit``.
+    """
+    logger = logger or MetricsLogger(None, echo=False)
+    attempt = 0
+    cfg_try = cfg
+    while True:
+        try:
+            return fit(cfg_try, train_ds, test_ds, checkpoint_dir=checkpoint_dir,
+                       logger=logger, **kwargs)
+        except Exception as err:  # noqa: BLE001 — any step failure is recoverable
+            attempt += 1
+            if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
+                raise
+            logger.log("recovery", attempt=attempt,
+                       retries_left=cfg.train.auto_resume_retries - attempt,
+                       error=repr(err)[:300])
+            cfg_try = copy.deepcopy(cfg)
+            cfg_try.train.resume = True
+
+
+def load_data_for(cfg: Config):
+    """Load the configured dataset and sync the model's class count (npz datasets
+    only know it after reading labels)."""
+    from ..data.datasets import load_dataset
+    train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
+                                     cfg.data.synthetic_size, seed=cfg.train.seed)
+    cfg.model.num_classes = train_ds.num_classes
+    return train_ds, test_ds
+
+
 def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
                               mesh, sharder, logger) -> list[dict]:
     """Produce one scoring-model variable pytree per seed.
@@ -193,13 +232,10 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
 
 def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, Any]:
     """End-to-end: (pretrain →) score → prune → retrain-from-scratch → final eval."""
-    from ..data.datasets import load_dataset
-
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
     mesh = make_mesh(cfg.mesh)
     sharder = BatchSharder(mesh)
-    train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
-                                     cfg.data.synthetic_size, seed=cfg.train.seed)
+    train_ds, test_ds = load_data_for(cfg)
 
     summary: dict[str, Any] = {"dataset": cfg.data.dataset, "n_train": len(train_ds),
                                "sparsity": cfg.prune.sparsity,
@@ -231,8 +267,9 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
     else:
         train_subset = train_ds
 
-    res = fit(cfg, train_subset, test_ds, mesh=mesh, sharder=sharder, logger=logger,
-              checkpoint_dir=cfg.train.checkpoint_dir, tag="final")
+    res = fit_with_recovery(cfg, train_subset, test_ds, mesh=mesh, sharder=sharder,
+                            logger=logger, checkpoint_dir=cfg.train.checkpoint_dir,
+                            tag="final")
     summary.update(
         final_test_accuracy=res.final_test_accuracy,
         train_wall_s=res.wall_s,

@@ -1,0 +1,4 @@
+from .stats import pearson, spearman
+from .trees import param_count, tree_bytes
+
+__all__ = ["pearson", "spearman", "param_count", "tree_bytes"]

@@ -1,0 +1,14 @@
+"""Pytree inspection helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def param_count(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))

@@ -1,0 +1,80 @@
+"""Failure recovery (restart-from-checkpoint) and the bring-your-own-npz dataset."""
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.data.datasets import load_dataset
+from data_diet_distributed_tpu.train import loop as loop_mod
+from data_diet_distributed_tpu.train.loop import fit_with_recovery, load_data_for
+
+
+def test_recovery_retries_with_resume(tiny_cfg, tiny_ds, mesh8, tmp_path,
+                                      monkeypatch):
+    train_ds, _ = tiny_ds
+    tiny_cfg.train.auto_resume_retries = 2
+    ckdir = str(tmp_path / "rec_ck")
+
+    real_fit = loop_mod.fit
+    calls = {"n": 0}
+
+    def flaky_fit(cfg, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected device failure")
+        return real_fit(cfg, *args, **kwargs)
+
+    monkeypatch.setattr(loop_mod, "fit", flaky_fit)
+    res = fit_with_recovery(tiny_cfg, train_ds, None, checkpoint_dir=ckdir,
+                            mesh=mesh8, num_epochs=1)
+    assert calls["n"] == 2
+    assert len(res.history) == 1
+    # the retry must have flipped resume on (restart-from-checkpoint semantics)
+    assert tiny_cfg.train.resume is False  # original config untouched
+
+
+def test_recovery_exhausts_retries(tiny_cfg, tiny_ds, mesh8, tmp_path, monkeypatch):
+    train_ds, _ = tiny_ds
+    tiny_cfg.train.auto_resume_retries = 1
+
+    def always_fail(*args, **kwargs):
+        raise RuntimeError("permanent failure")
+
+    monkeypatch.setattr(loop_mod, "fit", always_fail)
+    with pytest.raises(RuntimeError, match="permanent"):
+        fit_with_recovery(tiny_cfg, train_ds, None,
+                          checkpoint_dir=str(tmp_path / "x"), mesh=mesh8)
+
+
+def test_npz_dataset_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    for split, n in (("train", 48), ("test", 16)):
+        np.savez(tmp_path / f"{split}.npz",
+                 images=rng.integers(0, 256, size=(n, 16, 16, 3)).astype(np.uint8),
+                 labels=rng.integers(0, 7, n).astype(np.int64))
+    train, test = load_dataset("npz", data_dir=str(tmp_path))
+    assert train.images.shape == (48, 16, 16, 3)
+    assert train.images.dtype == np.float32
+    assert train.num_classes == 7
+    # normalized with train statistics: near zero mean / unit variance
+    assert abs(train.images.mean()) < 0.1
+    assert 0.8 < train.images.std() < 1.2
+    assert len(test) == 16
+
+
+def test_npz_syncs_model_classes(tmp_path):
+    rng = np.random.default_rng(1)
+    for split, n in (("train", 32), ("test", 8)):
+        np.savez(tmp_path / f"{split}.npz",
+                 images=rng.normal(size=(n, 8, 8, 3)).astype(np.float32),
+                 labels=rng.integers(0, 5, n).astype(np.int64))
+    cfg = load_config(None, [f"data.data_dir={tmp_path}", "data.dataset=npz"])
+    assert cfg.model.num_classes == 10  # unknown until load
+    load_data_for(cfg)
+    assert cfg.model.num_classes == 5
+
+
+def test_synthetic_imagenet_geometry():
+    train, test = load_dataset("synthetic_imagenet", synthetic_size=128)
+    assert train.images.shape == (128, 96, 96, 3)
+    assert train.num_classes == 100

@@ -1,0 +1,42 @@
+"""utils: rank-correlation statistics and tree helpers."""
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.utils import param_count, pearson, spearman
+
+
+def test_spearman_perfect_and_reversed():
+    a = np.array([1.0, 2.0, 3.0, 4.0])
+    assert spearman(a, a * 10 + 3) == pytest.approx(1.0)       # monotone map
+    assert spearman(a, -a) == pytest.approx(-1.0)
+
+
+def test_spearman_known_value():
+    # classic example with one swapped pair out of 5
+    a = np.array([1, 2, 3, 4, 5], float)
+    b = np.array([1, 2, 3, 5, 4], float)
+    # rho = 1 - 6*sum(d^2)/(n(n^2-1)) = 1 - 6*2/120 = 0.9
+    assert spearman(a, b) == pytest.approx(0.9)
+
+
+def test_spearman_ties_average_ranks():
+    a = np.array([1.0, 1.0, 2.0, 3.0])
+    b = np.array([1.0, 1.0, 2.0, 3.0])
+    assert spearman(a, b) == pytest.approx(1.0)
+
+
+def test_pearson_basic():
+    a = np.array([0.0, 1.0, 2.0])
+    assert pearson(a, 2 * a + 1) == pytest.approx(1.0)
+    assert pearson(a, np.zeros(3)) == 0.0
+
+
+def test_misaligned_rejected():
+    with pytest.raises(ValueError):
+        spearman(np.ones(3), np.ones(4))
+
+
+def test_param_count():
+    tree = {"a": np.zeros((2, 3)), "b": {"c": np.zeros(5)}}
+    assert param_count(tree) == 11
