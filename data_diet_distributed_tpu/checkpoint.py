@@ -40,7 +40,15 @@ class CheckpointManager:
         composite = {"state": ocp.args.StandardSave(payload)}
         if metrics:
             composite["metrics"] = ocp.args.JsonSave(metrics)
-        self._mngr.save(step, args=ocp.args.Composite(**composite))
+        if step in self._mngr.all_steps():
+            # A stale checkpoint from an earlier run sharing this directory (same
+            # step numbering) — overwrite it; Orbax otherwise raises
+            # StepAlreadyExistsError and the stale payload would shadow this run.
+            self._mngr.delete(step)
+        # force=True: Orbax's default policy silently skips saves at steps <= the
+        # directory's latest step, so a stale HIGHER-numbered checkpoint would
+        # otherwise swallow every save this run makes.
+        self._mngr.save(step, args=ocp.args.Composite(**composite), force=True)
         self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:

@@ -132,34 +132,66 @@ def _synthetic(size: int, num_classes: int, seed: int, split: str,
     return images, labels
 
 
+def _chunked_channel_stats(x_uint8: np.ndarray, chunk: int = 4096):
+    """Per-channel mean/std of uint8 images in [0,1] units, computed in chunks so a
+    multi-GB array never gets a full float32 copy."""
+    n = 0
+    s = np.zeros(x_uint8.shape[-1], np.float64)
+    s2 = np.zeros(x_uint8.shape[-1], np.float64)
+    for i in range(0, len(x_uint8), chunk):
+        c = x_uint8[i:i + chunk].astype(np.float64) / 255.0
+        s += c.sum(axis=(0, 1, 2))
+        s2 += np.square(c).sum(axis=(0, 1, 2))
+        n += c.shape[0] * c.shape[1] * c.shape[2]
+    mean = s / n
+    std = np.sqrt(np.maximum(s2 / n - mean**2, 0.0)) + 1e-8
+    return mean.astype(np.float32), std.astype(np.float32)
+
+
 def _load_npz(data_dir: str):
     """Bring-your-own-data path: ``{data_dir}/train.npz`` and ``test.npz`` with keys
-    ``images`` (NHWC uint8 or float32) and ``labels``. uint8 images are normalized
-    with per-channel statistics computed from the train split (or explicit ``mean`` /
-    ``std`` keys in train.npz). This is how real ImageNet subsets (BASELINE config 5)
-    are fed without any torchvision/tfds dependency."""
+    ``images`` (NHWC uint8 or float32) and ``labels``. uint8 images are scaled to
+    [0,1] and normalized with per-channel statistics computed from the train split,
+    or with explicit ``mean``/``std`` keys from train.npz (in [0,1] units). float32
+    images with explicit ``mean``/``std`` are normalized in their own units; float32
+    without stats are taken as already normalized. This is how real ImageNet subsets
+    (BASELINE config 5) are fed without any torchvision/tfds dependency."""
     paths = {s: os.path.join(data_dir, f"{s}.npz") for s in ("train", "test")}
     for p in paths.values():
         if not os.path.exists(p):
             raise FileNotFoundError(f"npz dataset missing {p}")
-    train = np.load(paths["train"])
-    test = np.load(paths["test"])
+    # Materialize each lazy NpzFile member exactly once (every [] access on an
+    # NpzFile re-decompresses the array from the zip).
+    with np.load(paths["train"]) as f:
+        train_x = np.asarray(f["images"])
+        train_y = np.asarray(f["labels"], np.int32)
+        explicit = "mean" in f and "std" in f
+        mean = np.asarray(f["mean"], np.float32) if explicit else None
+        std = np.asarray(f["std"], np.float32) if explicit else None
+    with np.load(paths["test"]) as f:
+        test_x = np.asarray(f["images"])
+        test_y = np.asarray(f["labels"], np.int32)
 
-    def stats():
-        if "mean" in train and "std" in train:
-            return (np.asarray(train["mean"], np.float32),
-                    np.asarray(train["std"], np.float32))
-        x = train["images"].astype(np.float32) / 255.0
-        return x.mean(axis=(0, 1, 2)), x.std(axis=(0, 1, 2)) + 1e-8
+    if not explicit and train_x.dtype != test_x.dtype:
+        # One split would be normalized and the other passed through raw — a silent
+        # train/test scale mismatch. Refuse loudly.
+        raise ValueError(
+            f"npz splits have mixed image dtypes (train {train_x.dtype}, test "
+            f"{test_x.dtype}) and no explicit mean/std keys in train.npz; provide "
+            "mean/std or make both splits the same dtype")
+    derived = None
+    if not explicit and train_x.dtype == np.uint8:
+        derived = _chunked_channel_stats(train_x)
 
-    def prep(d):
-        x = d["images"]
+    def prep(x):
         if x.dtype == np.uint8:
-            mean, std = stats()
-            x = _normalize(x, mean, std)
-        return x.astype(np.float32), np.asarray(d["labels"], np.int32)
+            return _normalize(x, mean, std) if explicit else _normalize(x, *derived)
+        x = x.astype(np.float32)
+        # Explicit stats apply to float32 in the images' own units; float32
+        # without explicit stats is taken as already normalized.
+        return (x - mean) / std if explicit else x
 
-    return prep(train), prep(test)
+    return (prep(train_x), train_y), (prep(test_x), test_y)
 
 
 def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2048,
@@ -178,7 +210,8 @@ def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2
         num_classes = 100
     elif dataset == "npz":
         (train_x, train_y), (test_x, test_y) = _load_npz(data_dir)
-        num_classes = int(train_y.max()) + 1
+        # Both splits count: a test-only class id must still fit the classifier.
+        num_classes = int(max(train_y.max(), test_y.max())) + 1
     elif dataset in ("cifar10", "cifar100"):
         (train_raw, train_y), (test_raw, test_y) = _load_cifar_batches(data_dir, dataset)
         mean, std = ((CIFAR10_MEAN, CIFAR10_STD) if dataset == "cifar10"

@@ -81,6 +81,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         mesh=None, sharder: BatchSharder | None = None,
         logger: MetricsLogger | None = None, num_epochs: int | None = None,
         seed: int | None = None, checkpoint_dir: str | None = None,
+        resume_step: int | None = None, saved_steps: list[int] | None = None,
         tag: str = "train") -> FitResult:
     """Train a fresh model (or resume) for exactly ``num_epochs`` epochs."""
     cfg = _with_epochs(cfg, num_epochs, seed)
@@ -102,8 +103,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     if checkpoint_dir:
         ckpt = CheckpointManager(checkpoint_dir,
                                  max_to_keep=cfg.train.keep_checkpoints)
-        if cfg.train.resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
+        if cfg.train.resume and (resume_step is not None
+                                 or ckpt.latest_step() is not None):
+            state = ckpt.restore(state, resume_step)
             start_epoch = int(state.step) // steps_per_epoch
             logger.log("resume", tag=tag, step=int(state.step), epoch=start_epoch)
 
@@ -112,6 +114,20 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
     result = FitResult(state=state)
     t_start = time.perf_counter()
+    try:
+        _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
+                    sharder, logger, ckpt, start_epoch, batch_size, tag, result,
+                    saved_steps)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    result.wall_s = time.perf_counter() - t_start
+    return result
+
+
+def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
+                sharder, logger, ckpt, start_epoch, batch_size, tag, result,
+                saved_steps=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         # Device scalars accumulate un-synced (async dispatch); host conversion
@@ -147,11 +163,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                                  or epoch + 1 == cfg.train.num_epochs):
             ckpt.save(int(state.step), state, metrics={"epoch": epoch, **{
                 k: v for k, v in record.items() if isinstance(v, (int, float))}})
-    result.state = state
-    result.wall_s = time.perf_counter() - t_start
-    if ckpt is not None:
-        ckpt.close()
-    return result
+            if saved_steps is not None:
+                saved_steps.append(int(state.step))
+        result.state = state
 
 
 def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
@@ -163,24 +177,35 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
 
     On an exception, re-enters training from the latest checkpoint, up to
     ``train.auto_resume_retries`` times. Requires a checkpoint_dir; with retries=0
-    this is exactly ``fit``.
+    this is exactly ``fit``. Only checkpoints written by THIS call are resumed from
+    (``fit`` reports the exact steps it saved via ``saved_steps``): a stale
+    checkpoint left in the directory by an earlier run (e.g. a dense ``cli train``
+    sharing the dir) would otherwise make the retry skip every epoch and report
+    success without training. A stale checkpoint whose step number collides with one
+    of this run's is overwritten at save time (``CheckpointManager.save``), so the
+    resumed payload is always this run's own.
     """
     logger = logger or MetricsLogger(None, echo=False)
     attempt = 0
     cfg_try = cfg
+    resume_step = None
+    saved_steps: list[int] = []
     while True:
         try:
             return fit(cfg_try, train_ds, test_ds, checkpoint_dir=checkpoint_dir,
-                       logger=logger, **kwargs)
+                       logger=logger, resume_step=resume_step,
+                       saved_steps=saved_steps, **kwargs)
         except Exception as err:  # noqa: BLE001 — any step failure is recoverable
             attempt += 1
             if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
                 raise
+            resume_step = max(saved_steps) if saved_steps else None
             logger.log("recovery", attempt=attempt,
                        retries_left=cfg.train.auto_resume_retries - attempt,
+                       resume=cfg.train.resume or resume_step is not None,
                        error=repr(err)[:300])
             cfg_try = copy.deepcopy(cfg)
-            cfg_try.train.resume = True
+            cfg_try.train.resume = cfg.train.resume or resume_step is not None
 
 
 def load_data_for(cfg: Config):

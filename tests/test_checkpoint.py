@@ -51,6 +51,25 @@ def test_resume_continues_training(tiny_cfg, tiny_ds, mesh8, tmp_path):
     assert int(res2.state.step) == steps_after_2 + steps_after_2 // 2
 
 
+def test_save_overwrites_colliding_step(tiny_cfg, tmp_path):
+    """A stale checkpoint at the same step number (directory reuse across runs) is
+    overwritten, not silently kept and not a StepAlreadyExistsError."""
+    stale = create_train_state(tiny_cfg, jax.random.key(0), steps_per_epoch=4)
+    mngr = CheckpointManager(str(tmp_path / "ck"))
+    mngr.save(4, stale)
+    mngr.close()
+
+    fresh = create_train_state(tiny_cfg, jax.random.key(123), steps_per_epoch=4)
+    mngr2 = CheckpointManager(str(tmp_path / "ck"))
+    mngr2.save(4, fresh)
+    restored = mngr2.restore(create_train_state(tiny_cfg, jax.random.key(7),
+                                                steps_per_epoch=4), 4)
+    got = np.concatenate([np.ravel(x) for x in jax.tree.leaves(restored.params)])
+    want = np.concatenate([np.ravel(x) for x in jax.tree.leaves(fresh.params)])
+    np.testing.assert_array_equal(got, want)
+    mngr2.close()
+
+
 def test_retention_limit(tiny_cfg, tmp_path):
     state = create_train_state(tiny_cfg, jax.random.key(0), steps_per_epoch=4)
     mngr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)

@@ -20,6 +20,7 @@ torch = pytest.importorskip("torch")  # oracle only; suite must survive without 
 import torch.nn as tnn  # noqa: E402
 import torch.nn.functional as F  # noqa: E402
 
+from data_diet_distributed_tpu.utils.stats import spearman
 from data_diet_distributed_tpu.models import create_model
 from data_diet_distributed_tpu.ops.scores import make_grand_step, make_el2n_step
 
@@ -150,13 +151,6 @@ def torch_grand(model, x_nchw, y):
         out.append(np.sqrt(sq))
     return np.asarray(out)
 
-
-def spearman(a, b):
-    ra = np.argsort(np.argsort(a)).astype(np.float64)
-    rb = np.argsort(np.argsort(b)).astype(np.float64)
-    ra -= ra.mean()
-    rb -= rb.mean()
-    return float((ra * rb).sum() / np.sqrt((ra ** 2).sum() * (rb ** 2).sum()))
 
 
 def _random_inputs(n, seed=0):

@@ -46,6 +46,65 @@ def test_recovery_exhausts_retries(tiny_cfg, tiny_ds, mesh8, tmp_path, monkeypat
                           checkpoint_dir=str(tmp_path / "x"), mesh=mesh8)
 
 
+def test_recovery_ignores_stale_checkpoint(tiny_cfg, tiny_ds, mesh8, tmp_path,
+                                           monkeypatch):
+    """A checkpoint left by a PREVIOUS run must not satisfy the retry: resume from
+    it would skip every epoch and report success without training."""
+    train_ds, _ = tiny_ds
+    ckdir = str(tmp_path / "stale_ck")
+    # Stale artifact from an earlier (longer) run.
+    loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8, num_epochs=1,
+                 checkpoint_dir=ckdir)
+
+    tiny_cfg.train.auto_resume_retries = 2
+    real_fit = loop_mod.fit
+    seen_resume, calls = [], {"n": 0}
+
+    def flaky_fit(cfg, *args, **kwargs):
+        calls["n"] += 1
+        seen_resume.append(cfg.train.resume)
+        if calls["n"] == 1:
+            raise RuntimeError("injected failure before any checkpoint")
+        return real_fit(cfg, *args, **kwargs)
+
+    monkeypatch.setattr(loop_mod, "fit", flaky_fit)
+    res = fit_with_recovery(tiny_cfg, train_ds, None, checkpoint_dir=ckdir,
+                            mesh=mesh8, num_epochs=1)
+    # The retry must NOT have resumed (no checkpoint of its own yet) — it restarts
+    # from scratch and actually trains.
+    assert seen_resume == [False, False]
+    assert len(res.history) == 1
+
+
+def test_recovery_resumes_own_checkpoint_not_stale(tiny_cfg, tiny_ds, mesh8,
+                                                   tmp_path, monkeypatch):
+    """When THIS run saved a checkpoint before crashing, the retry resumes from it
+    even if a stale higher-step checkpoint sits in the same directory."""
+    train_ds, _ = tiny_ds
+    ckdir = str(tmp_path / "own_ck")
+    # Stale artifact from an earlier longer run: checkpoint at step 8.
+    loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8, num_epochs=2,
+                 checkpoint_dir=ckdir)
+
+    tiny_cfg.train.auto_resume_retries = 1
+    real_fit = loop_mod.fit
+    calls = {"n": 0}
+
+    def flaky_fit(cfg, *args, **kwargs):
+        calls["n"] += 1
+        res = real_fit(cfg, *args, **kwargs)
+        if calls["n"] == 1:  # crash AFTER this run's own checkpoint (step 4) exists
+            raise RuntimeError("injected failure after checkpointing")
+        return res
+
+    monkeypatch.setattr(loop_mod, "fit", flaky_fit)
+    res = fit_with_recovery(tiny_cfg, train_ds, None, checkpoint_dir=ckdir,
+                            mesh=mesh8, num_epochs=1)
+    assert calls["n"] == 2
+    # Resumed from its own step-4 checkpoint, not the stale step-8 one.
+    assert int(res.state.step) == 4
+
+
 def test_npz_dataset_roundtrip(tmp_path):
     rng = np.random.default_rng(0)
     for split, n in (("train", 48), ("test", 16)):
@@ -60,6 +119,48 @@ def test_npz_dataset_roundtrip(tmp_path):
     assert abs(train.images.mean()) < 0.1
     assert 0.8 < train.images.std() < 1.2
     assert len(test) == 16
+
+
+def test_npz_num_classes_covers_test_split(tmp_path):
+    """A class id that appears only in test.npz must still size the classifier."""
+    rng = np.random.default_rng(2)
+    np.savez(tmp_path / "train.npz",
+             images=rng.integers(0, 256, size=(24, 8, 8, 3)).astype(np.uint8),
+             labels=rng.integers(0, 4, 24).astype(np.int64))
+    np.savez(tmp_path / "test.npz",
+             images=rng.integers(0, 256, size=(8, 8, 8, 3)).astype(np.uint8),
+             labels=np.full(8, 6, np.int64))  # class 6 unseen in train
+    train, test = load_dataset("npz", data_dir=str(tmp_path))
+    assert train.num_classes == 7
+    assert test.num_classes == 7
+
+
+def test_npz_float32_with_explicit_stats(tmp_path):
+    """float32 images + explicit mean/std keys are normalized in their own units."""
+    rng = np.random.default_rng(3)
+    imgs = rng.normal(5.0, 2.0, size=(32, 8, 8, 3)).astype(np.float32)
+    mean = imgs.mean(axis=(0, 1, 2))
+    std = imgs.std(axis=(0, 1, 2))
+    np.savez(tmp_path / "train.npz", images=imgs,
+             labels=rng.integers(0, 3, 32).astype(np.int64), mean=mean, std=std)
+    np.savez(tmp_path / "test.npz", images=imgs[:8], labels=np.zeros(8, np.int64))
+    train, _ = load_dataset("npz", data_dir=str(tmp_path))
+    assert abs(train.images.mean()) < 1e-3
+    assert abs(train.images.std() - 1.0) < 1e-3
+
+
+def test_npz_mixed_dtypes_without_stats_rejected(tmp_path):
+    """uint8 train + float32 test (or vice versa) with no explicit mean/std would
+    put the splits on different scales — must refuse loudly."""
+    rng = np.random.default_rng(4)
+    np.savez(tmp_path / "train.npz",
+             images=rng.integers(0, 256, size=(16, 8, 8, 3)).astype(np.uint8),
+             labels=rng.integers(0, 3, 16).astype(np.int64))
+    np.savez(tmp_path / "test.npz",
+             images=rng.normal(size=(8, 8, 8, 3)).astype(np.float32),
+             labels=rng.integers(0, 3, 8).astype(np.int64))
+    with pytest.raises(ValueError, match="mixed image dtypes"):
+        load_dataset("npz", data_dir=str(tmp_path))
 
 
 def test_npz_syncs_model_classes(tmp_path):
