@@ -62,13 +62,14 @@ def main() -> None:
                       iterate_batches(train_ds, batch_size, shuffle=False)]
 
     # Warmup: compile + one full pass.
-    out = [step(variables, b) for b in device_batches]
-    jax.block_until_ready(out[-1])
+    jax.block_until_ready([step(variables, b) for b in device_batches])
 
+    # Block on EVERY output each repeat: blocking only on the last dispatched array
+    # can report dispatch latency instead of execution time on async backends, while
+    # per-step blocking would serialize dispatch and under-report throughput.
     t0 = time.perf_counter()
     for _ in range(args.repeats):
-        out = [step(variables, b) for b in device_batches]
-    jax.block_until_ready(out[-1])
+        jax.block_until_ready([step(variables, b) for b in device_batches])
     wall = time.perf_counter() - t0
 
     examples_per_sec = args.size * args.repeats / wall

@@ -19,7 +19,7 @@ import sys
 import numpy as np
 
 from .config import Config, load_config
-from .obs import MetricsLogger, ResourceMonitor
+from .obs import MetricsLogger, ResourceMonitor, plot_metrics, plot_utilization
 
 
 def _build(argv: list[str]) -> tuple[str, Config]:
@@ -35,6 +35,8 @@ def _build(argv: list[str]) -> tuple[str, Config]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import time
+    run_started = time.time()
     command, cfg = _build(sys.argv[1:] if argv is None else argv)
     from .parallel.mesh import initialize_multihost
     initialize_multihost(cfg.mesh)
@@ -51,6 +53,20 @@ def main(argv: list[str] | None = None) -> int:
         logger.close()
         if monitor:
             monitor.stop()
+    if cfg.obs.plots_dir:
+        import jax
+        if jax.process_index() == 0:
+            try:
+                written = plot_metrics(cfg.obs.metrics_path, cfg.obs.plots_dir,
+                                       since_ts=run_started)
+                if monitor:
+                    written += plot_utilization(cfg.obs.monitor_path,
+                                                cfg.obs.plots_dir,
+                                                since_ts=run_started)
+                for p in written:
+                    print(f"[plots] wrote {p}", flush=True)
+            except Exception as exc:  # plots are best-effort; the run succeeded
+                print(f"[plots] rendering failed: {exc!r}", flush=True)
     return 0
 
 

@@ -132,6 +132,7 @@ class ObsConfig:
     monitor: bool = False            # 1 Hz host/device utilization sampling thread
     monitor_path: str = "./utilization.jsonl"
     profile_dir: str | None = None   # jax.profiler trace output directory
+    plots_dir: str | None = None     # post-run PNGs (reference: ddp_new.py:71-99)
 
 
 @dataclass

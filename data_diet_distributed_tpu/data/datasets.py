@@ -172,13 +172,13 @@ def _load_npz(data_dir: str):
         test_x = np.asarray(f["images"])
         test_y = np.asarray(f["labels"], np.int32)
 
-    if not explicit and train_x.dtype != test_x.dtype:
-        # One split would be normalized and the other passed through raw — a silent
-        # train/test scale mismatch. Refuse loudly.
+    if train_x.dtype != test_x.dtype:
+        # The two splits would be normalized on different scales (uint8 is rescaled
+        # to [0,1] before stats apply; float32 is used in its own units) — a silent
+        # train/test mismatch either way. Refuse loudly.
         raise ValueError(
             f"npz splits have mixed image dtypes (train {train_x.dtype}, test "
-            f"{test_x.dtype}) and no explicit mean/std keys in train.npz; provide "
-            "mean/std or make both splits the same dtype")
+            f"{test_x.dtype}); make both splits the same dtype")
     derived = None
     if not explicit and train_x.dtype == np.uint8:
         derived = _chunked_channel_stats(train_x)
@@ -186,7 +186,7 @@ def _load_npz(data_dir: str):
     def prep(x):
         if x.dtype == np.uint8:
             return _normalize(x, mean, std) if explicit else _normalize(x, *derived)
-        x = x.astype(np.float32)
+        x = x.astype(np.float32, copy=False)
         # Explicit stats apply to float32 in the images' own units; float32
         # without explicit stats is taken as already normalized.
         return (x - mean) / std if explicit else x

@@ -1,0 +1,139 @@
+"""Render utilization/metrics JSONL into PNG plots.
+
+Parity with the reference's post-run plotting (``ddp_new.py:71-99`` renders per-device
+CPU/GPU utilization PNGs from ``utilization_log.txt``), without its failure modes: the
+reference re-parses free text with a parser that NameErrors on a malformed first GPU
+line (``ddp_new.py:297-309``, SURVEY §2.4.8); here the monitor already wrote JSONL
+(one record per sample), so plotting is a straight read. Malformed lines are skipped,
+not fatal.
+
+matplotlib is imported lazily and the functions degrade to a no-op (returning ``[]``)
+when it is unavailable, so the core framework carries no plotting dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # partial last line from a crashed run is fine
+    return records
+
+
+def _mpl():
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        return plt
+    except Exception:
+        return None
+
+
+def plot_utilization(monitor_path: str, out_dir: str = "./plots",
+                     since_ts: float = 0.0) -> list[str]:
+    """Render host-CPU%% and per-device HBM-use plots from the ResourceMonitor log.
+
+    ``since_ts`` filters out records from earlier runs (both loggers append, so the
+    file may span several runs). Returns the list of files written (empty if
+    matplotlib is missing or the log holds no samples).
+    """
+    plt = _mpl()
+    if plt is None or not os.path.exists(monitor_path):
+        return []
+    records = [r for r in _read_jsonl(monitor_path)
+               if "cpu_pct" in r and r.get("ts", 0.0) >= since_ts]
+    if not records:
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = records[0].get("ts", 0.0)
+    times = [r.get("ts", t0) - t0 for r in records]
+    written: list[str] = []
+
+    fig, ax = plt.subplots(figsize=(8, 3))
+    ax.plot(times, [r.get("cpu_pct", 0.0) for r in records], lw=1.0)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("host CPU %")
+    ax.set_ylim(0, 100)
+    ax.set_title("Host CPU utilization")
+    fig.tight_layout()
+    path = os.path.join(out_dir, "cpu_utilization.png")
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    written.append(path)
+
+    # One HBM trace per device; devices discovered from the samples themselves.
+    # One unit for the whole axis: percent only when EVERY sample carries a limit,
+    # GiB otherwise (mixing per-point units would render a quantitatively wrong
+    # chart with no warning).
+    samples = [(t, dev) for t, r in zip(times, records)
+               for dev in r.get("devices", []) if dev.get("bytes_in_use") is not None]
+    as_pct = bool(samples) and all(dev.get("bytes_limit") for _, dev in samples)
+    series: dict[str, tuple[list[float], list[float]]] = {}
+    for t, dev in samples:
+        used = dev["bytes_in_use"]
+        val = 100.0 * used / dev["bytes_limit"] if as_pct else used / 2**30
+        xs, ys = series.setdefault(str(dev.get("device")), ([], []))
+        xs.append(t)
+        ys.append(val)
+    if series:
+        fig, ax = plt.subplots(figsize=(8, 3))
+        for name, (xs, ys) in sorted(series.items()):
+            ax.plot(xs, ys, lw=1.0, label=name)
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("HBM in use %" if as_pct else "HBM in use (GiB)")
+        ax.legend(fontsize=7)
+        ax.set_title("Device memory")
+        fig.tight_layout()
+        path = os.path.join(out_dir, "device_memory.png")
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+def plot_metrics(metrics_path: str, out_dir: str = "./plots",
+                 since_ts: float = 0.0) -> list[str]:
+    """Render loss / accuracy / throughput curves from the MetricsLogger JSONL.
+
+    ``since_ts`` keeps only the current run's records (the logger appends).
+    """
+    plt = _mpl()
+    if plt is None or not os.path.exists(metrics_path):
+        return []
+    records = [r for r in _read_jsonl(metrics_path) if r.get("ts", 0.0) >= since_ts]
+    os.makedirs(out_dir, exist_ok=True)
+    written: list[str] = []
+
+    def curve(kind: str, field: str, fname: str, ylabel: str):
+        pts = [(i, r[field]) for i, r in enumerate(records)
+               if r.get("kind") == kind and isinstance(r.get(field), (int, float))]
+        if not pts:
+            return
+        fig, ax = plt.subplots(figsize=(8, 3))
+        ax.plot([p[0] for p in pts], [p[1] for p in pts], lw=1.0)
+        ax.set_xlabel("event")
+        ax.set_ylabel(ylabel)
+        ax.set_title(f"{kind}: {field}")
+        fig.tight_layout()
+        path = os.path.join(out_dir, fname)
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        written.append(path)
+
+    curve("epoch", "train_loss", "train_loss.png", "loss")
+    curve("epoch", "test_accuracy", "eval_accuracy.png", "accuracy")
+    curve("epoch", "examples_per_s", "throughput.png", "examples/sec")
+    return written
