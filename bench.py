@@ -28,9 +28,9 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=4096,
                         help="examples in the scoring pass")
-    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--batch", type=int, default=1024)
     parser.add_argument("--method", default="grand",
-                        choices=["grand", "el2n", "grand_last_layer"])
+                        choices=["grand", "grand_vmap", "el2n", "grand_last_layer"])
     parser.add_argument("--arch", default="resnet18")
     parser.add_argument("--chunk", type=int, default=64,
                         help="vmap(grad) chunk per device for full GraNd")
@@ -61,15 +61,26 @@ def main() -> None:
     device_batches = [sharder(b) for b in
                       iterate_batches(train_ds, batch_size, shuffle=False)]
 
-    # Warmup: compile + one full pass.
-    jax.block_until_ready([step(variables, b) for b in device_batches])
+    import jax.numpy as jnp
 
-    # Block on EVERY output each repeat: blocking only on the last dispatched array
-    # can report dispatch latency instead of execution time on async backends, while
-    # per-step blocking would serialize dispatch and under-report throughput.
+    @jax.jit
+    def _checksum(outs):
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+    def run_pass():
+        # Synchronize by FETCHING a scalar reduction of every output.
+        # jax.block_until_ready is not a reliable barrier on every backend (some
+        # remote/tunneled runtimes return immediately from ready-checks); a host
+        # transfer cannot complete before the computation has, and a scalar makes
+        # the transfer itself free. All outputs feed the checksum, so nothing is
+        # dead-code-eliminated and dispatch stays fully async within the pass.
+        outs = [step(variables, b) for b in device_batches]
+        return float(jax.device_get(_checksum(outs)))
+
+    run_pass()  # warmup: compile + one full pass
     t0 = time.perf_counter()
     for _ in range(args.repeats):
-        jax.block_until_ready([step(variables, b) for b in device_batches])
+        run_pass()
     wall = time.perf_counter() - t0
 
     examples_per_sec = args.size * args.repeats / wall

@@ -63,7 +63,10 @@ class OptimConfig:
 class ScoreConfig:
     """Per-example scoring pass (reference: ``get_scores_and_prune.py``)."""
 
-    method: str = "el2n"          # el2n | grand | grand_last_layer
+    # el2n | grand | grand_vmap | grand_last_layer. "grand" is full-parameter
+    # GraNd via the batched exact algorithm (ops/grand_batched.py) in eval mode;
+    # "grand_vmap" forces the naive vmap(grad) path (cross-checks, exotic layers).
+    method: str = "el2n"
     # Which checkpoint feeds the scoring pass. The reference hard-codes epoch 19
     # (train.py:61, ddp.py:72); here it is a knob.
     score_ckpt_step: int | None = None    # None -> latest available checkpoint
@@ -152,7 +155,8 @@ class Config:
             raise ValueError(f"unknown dataset {self.data.dataset!r}")
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
-        if self.score.method not in ("el2n", "grand", "grand_last_layer"):
+        if self.score.method not in ("el2n", "grand", "grand_vmap",
+                                     "grand_last_layer"):
             raise ValueError(f"unknown score method {self.score.method!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):
             raise ValueError(f"unknown keep policy {self.prune.keep!r}")

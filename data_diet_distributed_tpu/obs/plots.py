@@ -118,13 +118,20 @@ def plot_metrics(metrics_path: str, out_dir: str = "./plots",
     written: list[str] = []
 
     def curve(kind: str, field: str, fname: str, ylabel: str):
-        pts = [(i, r[field]) for i, r in enumerate(records)
-               if r.get("kind") == kind and isinstance(r.get(field), (int, float))]
+        matching = [r for r in records if r.get("kind") == kind
+                    and isinstance(r.get(field), (int, float))]
+        # x-axis: the record's own epoch when present (fit tags restart epoch
+        # numbering, so fall back to series position for mixed-tag logs).
+        epochs = [r.get("epoch") for r in matching]
+        use_epoch = (all(isinstance(e, int) for e in epochs)
+                     and len(set(epochs)) == len(epochs))
+        pts = [(epochs[i] if use_epoch else i, r[field])
+               for i, r in enumerate(matching)]
         if not pts:
             return
         fig, ax = plt.subplots(figsize=(8, 3))
         ax.plot([p[0] for p in pts], [p[1] for p in pts], lw=1.0)
-        ax.set_xlabel("event")
+        ax.set_xlabel("epoch" if use_epoch else "event")
         ax.set_ylabel(ylabel)
         ax.set_title(f"{kind}: {field}")
         fig.tight_layout()

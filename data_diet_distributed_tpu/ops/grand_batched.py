@@ -1,0 +1,230 @@
+"""Batched exact GraNd: per-example gradient norms without per-example backwards.
+
+The naive full GraNd (``scores.make_grand_step``) is ``vmap(grad)`` over single
+examples — each example's backward runs convolutions at batch size 1, which the
+MXU cannot tile efficiently. This module computes the SAME quantity,
+``‖∇_θ ℓ(f(x_i), y_i)‖₂`` over all parameters, from ONE batched forward and ONE
+batched backward:
+
+1. every ``Conv``/``Dense``/``BatchNorm`` output ``y`` gets a zero "perturbation"
+   added (``flax`` interceptor — no model changes); the gradient of the summed
+   per-example loss w.r.t. that zero is the **per-example cotangent** ``g_i`` at
+   that layer output (activations are per-example, so unlike weight gradients
+   these never sum over the batch);
+2. each layer's per-example weight-gradient norm then has a closed form in terms
+   of its input ``x_i`` (captured by the same interceptor) and ``g_i``:
+
+   * Dense ``y = xW + b``:  ``∂ℓᵢ/∂W = xᵢ gᵢᵀ`` ⇒ ``‖∂W‖² = ‖xᵢ‖²·‖gᵢ‖²`` and
+     ``‖∂b‖² = ‖gᵢ‖²`` (Goodfellow 2015's per-example-norm trick);
+   * Conv: with ``P_i ∈ [S, F]`` the im2col patch matrix (``F = C·kh·kw``, ``S``
+     output positions) and ``G_i ∈ [S, K]`` the cotangent, ``∂ℓᵢ/∂W = P_iᵀ G_i``
+     ⇒ ``‖∂W‖²_F`` is either the direct contraction ``Σ_{fk}(P_iᵀG_i)²`` or the
+     Gram form ``Σ_{ss'}(P_iP_iᵀ)_{ss'}(G_iG_iᵀ)_{ss'}`` — whichever is cheaper
+     for the layer's geometry (direct for early layers where ``S`` is large,
+     Gram for late layers where ``F·K`` dominates). Both are batched matmuls;
+   * eval-mode BatchNorm ``y = γ·x̂ + β``: ``∂ℓᵢ/∂γ = Σ_s gᵢx̂ᵢ``,
+     ``∂ℓᵢ/∂β = Σ_s gᵢ`` with ``x̂`` recomputed from the captured input and the
+     (constant) running statistics.
+
+Cost: one forward + one input-gradient backward + one MXU-friendly batched
+contraction per parameterized layer — the same FLOPs as ``vmap(grad)`` but
+executed as large matmuls instead of batch-1 convolutions.
+
+Exactness requires eval-mode scoring (train-mode BatchNorm normalizes by batch
+statistics, which couples examples; the ``vmap(grad)`` path normalizes each
+example by itself there — neither is "the" per-example gradient, so the fast
+path refuses and callers fall back to ``vmap(grad)``). Verified against
+``vmap(grad)`` to float tolerance in ``tests/test_grand_batched.py``.
+
+Reference context: the PyTorch reference has no GraNd at all (SURVEY §2.3 —
+EL2N only, ``get_scores_and_prune.py:15-18``); full-parameter GraNd is the
+BASELINE.json north-star capability, and this is its TPU-native fast path.
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+_F32 = jnp.float32
+
+
+def _canon_tuple(v, n: int) -> tuple:
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _canon_padding(padding, n: int):
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, int):
+        return ((padding, padding),) * n
+    out = []
+    for p in padding:
+        out.append((p, p) if isinstance(p, int) else tuple(p))
+    return tuple(out)
+
+
+def _record_for(mod) -> dict:
+    """Static per-layer metadata needed to rebuild the weight-grad norm later."""
+    path = tuple(mod.path)
+    if isinstance(mod, nn.Conv):
+        n = len(mod.kernel_size)
+        if mod.feature_group_count != 1:
+            raise NotImplementedError(
+                "batched GraNd supports feature_group_count=1 convolutions only "
+                f"(module {'/'.join(path)}); use the grand_vmap score method")
+        if _canon_tuple(mod.kernel_dilation, n) != (1,) * n or \
+                _canon_tuple(mod.input_dilation, n) != (1,) * n:
+            raise NotImplementedError(
+                f"batched GraNd does not support dilated convolutions "
+                f"(module {'/'.join(path)}); use the grand_vmap score method")
+        return {"kind": "conv", "path": path,
+                "kernel_size": tuple(mod.kernel_size),
+                "strides": _canon_tuple(mod.strides, n),
+                "padding": _canon_padding(mod.padding, n),
+                "use_bias": mod.use_bias}
+    if isinstance(mod, nn.Dense):
+        return {"kind": "dense", "path": path, "use_bias": mod.use_bias}
+    # BatchNorm. use_running_average may be resolved per-call; our zoo fixes it
+    # at construction (models/resnet.py norm partial), so the attribute is truthy
+    # in eval mode — the only mode this path accepts (module docstring).
+    if mod.use_running_average is not True:
+        raise ValueError(
+            f"batched GraNd requires eval-mode BatchNorm (module {'/'.join(path)} "
+            "has use_running_average != True); use the grand_vmap score method")
+    return {"kind": "bn", "path": path, "epsilon": float(mod.epsilon),
+            "use_scale": mod.use_scale, "use_bias": mod.use_bias}
+
+
+def _make_interceptor(records: list | None):
+    """Wrap every Conv/Dense/BatchNorm ``__call__``: capture the input into the
+    ``ddt_in`` collection and add a zero perturbation (``ddt_pert``) to the
+    output. ``records`` (when not None) collects the static layer metadata."""
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if (context.method_name != "__call__"
+                or not isinstance(mod, (nn.Conv, nn.Dense, nn.BatchNorm))
+                or mod.scope is None):
+            return next_fun(*args, **kwargs)
+        if records is not None:
+            records.append(_record_for(mod))
+        mod.sow("ddt_in", "x", args[0], reduce_fn=lambda _, b: b, init_fn=lambda: 0)
+        y = next_fun(*args, **kwargs)
+        return mod.perturb("y", y, collection="ddt_pert")
+
+    return interceptor
+
+
+def _leaf(tree, path: tuple, name: str):
+    return reduce(lambda d, k: d[k], path, tree)[name]
+
+
+def _sq(x, axis):
+    x = x.astype(_F32)
+    return jnp.sum(x * x, axis=axis)
+
+
+def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
+    """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
+    batch = x.shape[0]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=rec["kernel_size"], window_strides=rec["strides"],
+        padding=rec["padding"],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    s = int(np_prod(g.shape[1:-1]))
+    p = patches.reshape(batch, s, patches.shape[-1])     # [B, S, F]
+    go = g.reshape(batch, s, g.shape[-1])                # [B, S, K]
+    f, k = p.shape[-1], go.shape[-1]
+    if s * (f + k) < f * k:
+        # Gram form: Σ_{ss'} (PPᵀ)(GGᵀ) — S² dominates F·K for late layers.
+        pp = jnp.einsum("bsf,btf->bst", p, p, preferred_element_type=_F32)
+        gg = jnp.einsum("bsk,btk->bst", go, go, preferred_element_type=_F32)
+        contrib = jnp.sum(pp * gg, axis=(1, 2))
+    else:
+        m = jnp.einsum("bsf,bsk->bfk", p, go, preferred_element_type=_F32)
+        contrib = jnp.sum(m * m, axis=(1, 2))
+    if rec["use_bias"]:
+        contrib = contrib + _sq(jnp.sum(go.astype(_F32), axis=1), axis=-1)
+    return contrib
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for v in shape:
+        out *= int(v)
+    return out
+
+
+def _dense_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
+    contrib = _sq(x, axis=tuple(range(1, x.ndim))) * _sq(g, tuple(range(1, g.ndim)))
+    if rec["use_bias"]:
+        contrib = contrib + _sq(g, tuple(range(1, g.ndim)))
+    return contrib
+
+
+def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array:
+    stats_scope = reduce(lambda d, k: d[k], rec["path"], batch_stats)
+    mean, var = stats_scope["mean"], stats_scope["var"]
+    xhat = (x.astype(_F32) - mean) * jax.lax.rsqrt(var.astype(_F32)
+                                                   + rec["epsilon"])
+    axes = tuple(range(1, x.ndim - 1))
+    g32 = g.astype(_F32)
+    contrib = 0.0
+    if rec["use_scale"]:
+        contrib = contrib + _sq(jnp.sum(g32 * xhat, axis=axes), axis=-1)
+    if rec["use_bias"]:
+        contrib = contrib + _sq(jnp.sum(g32, axis=axes), axis=-1)
+    return contrib
+
+
+def batched_grand_scores(model, variables, image, label, mask) -> jax.Array:
+    """Exact per-example GraNd over all parameters, fully batched. [B] <- batch."""
+    from .scores import cross_entropy  # local import: scores.py imports this module
+
+    records: list[dict] = []
+    cap_int = _make_interceptor(records)
+    run_int = _make_interceptor(None)
+
+    def apply_fn(perts, interceptor, img):
+        with nn.intercept_methods(interceptor):
+            return model.apply({**variables, "ddt_pert": perts}, img,
+                               train=False, mutable=["ddt_in"])
+
+    # Shape pass (abstract — no FLOPs): records layer metadata and yields the
+    # perturbation-tree structure, i.e. every layer's output shape.
+    def init_shapes(img):
+        with nn.intercept_methods(cap_int):
+            _, mut = model.apply(variables, img, train=False,
+                                 mutable=["ddt_pert", "ddt_in"])
+        return mut["ddt_pert"]
+    pert_shapes = jax.eval_shape(init_shapes, image)
+    perts0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), pert_shapes)
+
+    def loss_fn(perts):
+        logits, mut = apply_fn(perts, run_int, image)
+        loss = jnp.sum(cross_entropy(logits, label) * mask)
+        return loss, mut["ddt_in"]
+
+    cotangents, captures = jax.grad(loss_fn, has_aux=True)(perts0)
+
+    batch_stats = variables.get("batch_stats", {})
+    norm_sq = jnp.zeros(image.shape[0], _F32)
+    for rec in records:
+        x = _leaf(captures, rec["path"], "x")   # sow reduce_fn stores the raw array
+        g = _leaf(cotangents, rec["path"], "y")
+        if rec["kind"] == "conv":
+            norm_sq = norm_sq + _conv_contrib(rec, x, g)
+        elif rec["kind"] == "dense":
+            norm_sq = norm_sq + _dense_contrib(rec, x, g)
+        else:
+            norm_sq = norm_sq + _bn_contrib(rec, x, g, batch_stats)
+    return jnp.sqrt(norm_sq) * mask

@@ -184,13 +184,37 @@ def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
 
 
 @functools.cache
+def make_grand_batched_step(model, mesh: Mesh | None = None,
+                            data_axis: str = "data"):
+    """Full GraNd via the batched exact algorithm (``grand_batched.py``): one
+    batched forward + one backward w.r.t. per-layer output perturbations, then
+    closed-form per-layer norm contractions — no per-example backwards, so the
+    MXU sees large batched matmuls instead of batch-1 convolutions. Eval-mode
+    only (train-mode BatchNorm couples examples; see the module docstring)."""
+    from .grand_batched import batched_grand_scores
+
+    def local_scores(variables, image, label, mask):
+        return batched_grand_scores(model, variables, image, label, mask)
+
+    return _wrap(local_scores, mesh, data_axis)
+
+
+@functools.cache
 def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 32,
                     eval_mode: bool = True, use_pallas: bool | None = False):
-    """Factory keyed by config string (el2n | grand | grand_last_layer)."""
+    """Factory keyed by config string (el2n | grand | grand_vmap |
+    grand_last_layer). ``grand`` runs the batched exact algorithm in eval mode
+    and falls back to ``vmap(grad)`` for train-mode (reference-quirk) scoring;
+    ``grand_vmap`` forces the naive path (cross-checking, exotic layers)."""
     if method == "el2n":
         return make_el2n_step(model, mesh, eval_mode=eval_mode,
                               use_pallas=use_pallas)
     if method == "grand":
+        if eval_mode:
+            return make_grand_batched_step(model, mesh)
+        return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode,
+                               use_pallas=use_pallas)
+    if method == "grand_vmap":
         return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode,
                                use_pallas=use_pallas)
     if method == "grand_last_layer":
