@@ -133,6 +133,20 @@ def _sq(x, axis):
     return jnp.sum(x * x, axis=axis)
 
 
+def _matrix_grad_norm_sq(p: jax.Array, go: jax.Array) -> jax.Array:
+    """[B] ⟵ ``‖P_iᵀ G_i‖²_F`` for P [B, S, F], G [B, S, K] — the shared-weight
+    per-example gradient norm (conv patches; Dense applied per position). Direct
+    contraction or Gram form, whichever the layer geometry makes cheaper."""
+    s, f, k = p.shape[1], p.shape[-1], go.shape[-1]
+    if s * (f + k) < f * k:
+        # Gram form: Σ_{ss'} (PPᵀ)(GGᵀ) — S² dominates F·K for late layers.
+        pp = jnp.einsum("bsf,btf->bst", p, p, preferred_element_type=_F32)
+        gg = jnp.einsum("bsk,btk->bst", go, go, preferred_element_type=_F32)
+        return jnp.sum(pp * gg, axis=(1, 2))
+    m = jnp.einsum("bsf,bsk->bfk", p, go, preferred_element_type=_F32)
+    return jnp.sum(m * m, axis=(1, 2))
+
+
 def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
     """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
     batch = x.shape[0]
@@ -141,19 +155,11 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
         padding=rec["padding"],
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
     s = int(np_prod(g.shape[1:-1]))
-    p = patches.reshape(batch, s, patches.shape[-1])     # [B, S, F]
-    go = g.reshape(batch, s, g.shape[-1])                # [B, S, K]
-    f, k = p.shape[-1], go.shape[-1]
-    if s * (f + k) < f * k:
-        # Gram form: Σ_{ss'} (PPᵀ)(GGᵀ) — S² dominates F·K for late layers.
-        pp = jnp.einsum("bsf,btf->bst", p, p, preferred_element_type=_F32)
-        gg = jnp.einsum("bsk,btk->bst", go, go, preferred_element_type=_F32)
-        contrib = jnp.sum(pp * gg, axis=(1, 2))
-    else:
-        m = jnp.einsum("bsf,bsk->bfk", p, go, preferred_element_type=_F32)
-        contrib = jnp.sum(m * m, axis=(1, 2))
+    contrib = _matrix_grad_norm_sq(patches.reshape(batch, s, patches.shape[-1]),
+                                   g.reshape(batch, s, g.shape[-1]))
     if rec["use_bias"]:
-        contrib = contrib + _sq(jnp.sum(go.astype(_F32), axis=1), axis=-1)
+        contrib = contrib + _sq(jnp.sum(g.astype(_F32).reshape(batch, s, -1),
+                                        axis=1), axis=-1)
     return contrib
 
 
@@ -165,9 +171,19 @@ def np_prod(shape) -> int:
 
 
 def _dense_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
-    contrib = _sq(x, axis=tuple(range(1, x.ndim))) * _sq(g, tuple(range(1, g.ndim)))
+    if x.ndim == 2:
+        # Goodfellow's identity: ∂W = x gᵀ ⇒ ‖∂W‖² = ‖x‖²‖g‖².
+        contrib = _sq(x, axis=1) * _sq(g, axis=1)
+    else:
+        # Dense applied per position ([B, ..., F]): the weight is SHARED across
+        # positions, so ∂W = Σ_s x_s g_sᵀ — the factored identity does not hold;
+        # use the same matrix contraction as conv patches.
+        batch = x.shape[0]
+        contrib = _matrix_grad_norm_sq(x.reshape(batch, -1, x.shape[-1]),
+                                       g.reshape(batch, -1, g.shape[-1]))
     if rec["use_bias"]:
-        contrib = contrib + _sq(g, tuple(range(1, g.ndim)))
+        gb = g.astype(_F32).reshape(g.shape[0], -1, g.shape[-1]).sum(axis=1)
+        contrib = contrib + _sq(gb, axis=-1)
     return contrib
 
 
@@ -213,6 +229,21 @@ def batched_grand_scores(model, variables, image, label, mask) -> jax.Array:
         logits, mut = apply_fn(perts, run_int, image)
         loss = jnp.sum(cross_entropy(logits, label) * mask)
         return loss, mut["ddt_in"]
+
+    # Completeness: every parameter must belong to an intercepted layer —
+    # otherwise its gradient would be silently missing from the norm (unlike the
+    # loud NotImplementedErrors for grouped/dilated convs). Conservative by
+    # design: a parameterized-but-unused module also trips this (its true
+    # contribution is zero, but we cannot tell "unused" from "missed" here).
+    covered = {rec["path"] for rec in records}
+    for path, _ in jax.tree_util.tree_flatten_with_path(
+            variables.get("params", {}))[0]:
+        mod_path = tuple(p.key for p in path[:-1])
+        if mod_path not in covered:
+            raise NotImplementedError(
+                f"batched GraNd: parameters at {'/'.join(mod_path)} belong to a "
+                "module type the interceptor does not cover (only Conv/Dense/"
+                "BatchNorm are); use the grand_vmap score method")
 
     cotangents, captures = jax.grad(loss_fn, has_aux=True)(perts0)
 

@@ -87,6 +87,51 @@ def test_sharded_equals_single_device(mesh8):
                                rtol=1e-4, atol=1e-6)
 
 
+class _PerPositionDense(__import__("flax").linen.Module):
+    """Dense applied per spatial position ([B, S, F] input) — the weight is
+    shared across positions, so Goodfellow's factored identity does not apply."""
+
+    num_classes: int = 10
+
+    @__import__("flax").linen.compact
+    def __call__(self, x, *, train: bool = False, capture_features: bool = False):
+        import flax.linen as nn
+        import jax.numpy as jnp
+        b = x.shape[0]
+        x = x.reshape(b, -1, x.shape[-1])              # [B, S, C]
+        x = nn.relu(nn.Dense(8, name="per_pos")(x))    # rank-3 Dense input
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(self.num_classes, name="classifier")(x)
+
+
+def test_per_position_dense_matches_vmap():
+    model = _PerPositionDense()
+    batch = _batch(6, 8, seed=4)
+    variables = _init(model, 8)
+    fast = make_grand_batched_step(model)(variables, batch)
+    ref = make_grand_step(model, chunk=3)(variables, batch)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_uncovered_parameterized_module_refuses():
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class WithGroupNorm(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.GroupNorm(num_groups=2)(x)   # parameterized, not intercepted
+            return nn.Dense(10)(jnp.mean(x, axis=(1, 2)))
+
+    model = WithGroupNorm()
+    batch = _batch(4, 8)
+    variables = _init(model, 8)
+    with pytest.raises(NotImplementedError, match="grand_vmap"):
+        make_grand_batched_step(model)(variables, batch)
+
+
 def test_score_step_dispatch():
     """method='grand' resolves to the batched path in eval mode and to
     vmap(grad) for train-mode (reference-quirk) scoring; both stay finite."""

@@ -22,7 +22,8 @@ import torch.nn.functional as F  # noqa: E402
 
 from data_diet_distributed_tpu.utils.stats import spearman
 from data_diet_distributed_tpu.models import create_model
-from data_diet_distributed_tpu.ops.scores import make_grand_step, make_el2n_step
+from data_diet_distributed_tpu.ops.scores import (make_el2n_step, make_grand_step,
+                                                  make_score_step)
 
 torch.manual_seed(0)
 
@@ -186,7 +187,29 @@ def test_grand_parity_tiny():
     variables = model.init(jax.random.key(1), jnp.asarray(x[:1]))
     tmodel = port_flax_to_torch(variables, TorchTinyCNN())
 
-    jx = np.asarray(make_grand_step(model, None, chunk=8)(variables, {
+    batch = {"image": jnp.asarray(x), "label": jnp.asarray(y.astype(np.int32)),
+             "mask": jnp.ones(n)}
+    jx = np.asarray(make_grand_step(model, None, chunk=8)(variables, batch))
+    th = torch_grand(tmodel, torch.tensor(x.transpose(0, 3, 1, 2)), torch.tensor(y))
+    assert np.allclose(jx, th, rtol=1e-3, atol=1e-4), np.abs(jx - th).max()
+    assert spearman(jx, th) >= 0.98
+    # The batched exact algorithm (the production 'grand' path) against the same
+    # torch per-example-loop oracle.
+    jx_batched = np.asarray(make_score_step(model, "grand")(variables, batch))
+    assert np.allclose(jx_batched, th, rtol=1e-3, atol=1e-4), (
+        np.abs(jx_batched - th).max())
+
+
+def test_grand_batched_parity_resnet18():
+    """Full-parameter batched GraNd on ResNet-18 vs the torch oracle: the headline
+    capability (BASELINE.json north star) at exact-weight-port tolerance."""
+    n = 8
+    x, y = _random_inputs(n, seed=5)
+    model = create_model("resnet18", 10)
+    variables = model.init(jax.random.key(2), jnp.asarray(x[:1]))
+    tmodel = port_flax_to_torch(variables, TorchResNet18())
+
+    jx = np.asarray(make_score_step(model, "grand")(variables, {
         "image": jnp.asarray(x), "label": jnp.asarray(y.astype(np.int32)),
         "mask": jnp.ones(n)}))
     th = torch_grand(tmodel, torch.tensor(x.transpose(0, 3, 1, 2)), torch.tensor(y))
