@@ -91,7 +91,7 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
         seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
                                                sharder=sharder, logger=logger)
         model = create_model(cfg.model.arch, cfg.model.num_classes,
-                             cfg.train.half_precision)
+                             cfg.train.half_precision, stem=cfg.model.stem)
         scores = score_dataset(model, seeds_vars, train_ds,
                                method=cfg.score.method,
                                batch_size=cfg.score.batch_size,

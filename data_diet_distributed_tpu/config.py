@@ -43,6 +43,9 @@ class ModelConfig:
 
     arch: str = "resnet18"   # resnet18/34/50/101/152 | wideresnet28_10
     num_classes: int = 10
+    # ResNet input geometry: "cifar" (3x3/s1 stem, no pool — the reference's,
+    # models/resnet.py:71-73) or "imagenet" (7x7/s2 + 3x3/s2 max-pool).
+    stem: str = "cifar"
 
 
 @dataclass
@@ -158,6 +161,8 @@ class Config:
         if self.score.method not in ("el2n", "grand", "grand_vmap",
                                      "grand_last_layer"):
             raise ValueError(f"unknown score method {self.score.method!r}")
+        if self.model.stem not in ("cifar", "imagenet"):
+            raise ValueError(f"unknown stem {self.model.stem!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):
             raise ValueError(f"unknown keep policy {self.prune.keep!r}")
         if (self.data.num_classes is not None

@@ -6,6 +6,8 @@ they are looked up by config string so the trainer/scorer are model-agnostic.
 
 from __future__ import annotations
 
+import inspect
+
 import jax.numpy as jnp
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
@@ -23,13 +25,22 @@ _REGISTRY = {
 }
 
 
-def create_model(arch: str, num_classes: int, half_precision: bool = False):
+def create_model(arch: str, num_classes: int, half_precision: bool = False,
+                 stem: str = "cifar"):
     """Instantiate a model by name. ``half_precision`` selects bfloat16 compute
-    (fp32 params) — the TPU-native mixed-precision recipe."""
+    (fp32 params) — the TPU-native mixed-precision recipe. ``stem`` picks the
+    ResNet input geometry: "cifar" (3x3/s1, the reference's) or "imagenet"
+    (7x7/s2 + max-pool, for the ImageNet-subset configs)."""
     if arch not in _REGISTRY:
         raise ValueError(f"unknown arch {arch!r}; known: {sorted(_REGISTRY)}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
-    return _REGISTRY[arch](num_classes=num_classes, dtype=dtype)
+    factory = _REGISTRY[arch]
+    # Capability dispatch: a factory advertises stem support via its signature.
+    if "stem" in inspect.signature(factory).parameters:
+        return factory(num_classes=num_classes, dtype=dtype, stem=stem)
+    if stem != "cifar":
+        raise ValueError(f"arch {arch!r} has no {stem!r} stem variant")
+    return factory(num_classes=num_classes, dtype=dtype)
 
 
 __all__ = [

@@ -103,6 +103,10 @@ class ResNet(nn.Module):
     num_classes: int = 10
     width: int = 64
     dtype: Any = jnp.float32
+    # "cifar": 3x3 stride-1 stem, no pool (the reference's geometry,
+    # models/resnet.py:71-73). "imagenet": 7x7 stride-2 conv + 3x3 stride-2
+    # max-pool — the standard large-image stem for the ImageNet-subset config.
+    stem: str = "cifar"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False, capture_features: bool = False):
@@ -112,8 +116,16 @@ class ResNet(nn.Module):
                        epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32)
 
         x = x.astype(self.dtype)
-        x = conv(self.width, (3, 3), padding=PAD1, name="stem_conv")(x)
-        x = nn.relu(norm(name="stem_norm")(x))
+        if self.stem == "imagenet":
+            x = conv(self.width, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                     name="stem_conv")(x)
+            x = nn.relu(norm(name="stem_norm")(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        elif self.stem == "cifar":
+            x = conv(self.width, (3, 3), padding=PAD1, name="stem_conv")(x)
+            x = nn.relu(norm(name="stem_norm")(x))
+        else:
+            raise ValueError(f"unknown stem {self.stem!r} (cifar | imagenet)")
         for stage, num_blocks in enumerate(self.stage_sizes):
             filters = self.width * (2 ** stage)
             for block in range(num_blocks):
@@ -130,21 +142,26 @@ class ResNet(nn.Module):
         return logits
 
 
-def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet((2, 2, 2, 2), BasicBlock, num_classes=num_classes, dtype=dtype)
+def ResNet18(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+    return ResNet((2, 2, 2, 2), BasicBlock, num_classes=num_classes, dtype=dtype,
+                  stem=stem)
 
 
-def ResNet34(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet((3, 4, 6, 3), BasicBlock, num_classes=num_classes, dtype=dtype)
+def ResNet34(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+    return ResNet((3, 4, 6, 3), BasicBlock, num_classes=num_classes, dtype=dtype,
+                  stem=stem)
 
 
-def ResNet50(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet((3, 4, 6, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)
+def ResNet50(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+    return ResNet((3, 4, 6, 3), BottleneckBlock, num_classes=num_classes,
+                  dtype=dtype, stem=stem)
 
 
-def ResNet101(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet((3, 4, 23, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)
+def ResNet101(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+    return ResNet((3, 4, 23, 3), BottleneckBlock, num_classes=num_classes,
+                  dtype=dtype, stem=stem)
 
 
-def ResNet152(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
-    return ResNet((3, 8, 36, 3), BottleneckBlock, num_classes=num_classes, dtype=dtype)
+def ResNet152(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+    return ResNet((3, 8, 36, 3), BottleneckBlock, num_classes=num_classes,
+                  dtype=dtype, stem=stem)

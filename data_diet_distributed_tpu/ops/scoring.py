@@ -32,7 +32,10 @@ def _to_host(batched: list[jax.Array]) -> list[np.ndarray]:
 
 
 # Keep the whole dataset device-resident across scoring seeds when it fits
-# comfortably in HBM (CIFAR at fp32 is ~0.6 GiB; ImageNet-scale npz sets stream).
+# comfortably in HBM: up to 1 GiB per mesh device (batches are spread over the
+# mesh), capped at 4 GiB total. CIFAR at fp32 (~0.6 GiB) qualifies on a single
+# chip; ImageNet-scale npz sets stream.
+_DEVICE_RESIDENT_PER_DEVICE_BYTES = 1 << 30
 _DEVICE_RESIDENT_MAX_BYTES = 4 << 30
 
 
@@ -63,8 +66,11 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     pos_of[ds.indices] = np.arange(n)
 
     if device_resident is None:
+        n_dev = sharder.mesh.size if sharder is not None else 1
+        budget = min(n_dev * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
+                     _DEVICE_RESIDENT_MAX_BYTES)
         device_resident = (len(variables_seeds) > 1
-                           and ds.images.nbytes <= _DEVICE_RESIDENT_MAX_BYTES)
+                           and ds.images.nbytes <= budget)
 
     def device_batches():
         for host_batch in iterate_batches(ds, batch_size, shuffle=False):

@@ -92,7 +92,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
     steps_per_epoch = num_batches(len(train_ds), batch_size)
     model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision)
+                         cfg.train.half_precision, stem=cfg.model.stem)
     rng = jax.random.key(cfg.train.seed)
     state = create_train_state(cfg, rng, steps_per_epoch,
                                sample_shape=(1, *train_ds.images.shape[1:]))
@@ -247,7 +247,7 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
             out.append(res.state.variables)
         else:
             model = create_model(cfg.model.arch, cfg.model.num_classes,
-                                 cfg.train.half_precision)
+                                 cfg.train.half_precision, stem=cfg.model.stem)
             variables = jax.jit(model.init, static_argnames=("train",))(
                 jax.random.key(int(s)),
                 np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
@@ -271,7 +271,7 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
                                                sharder=sharder, logger=logger)
         model = create_model(cfg.model.arch, cfg.model.num_classes,
-                             cfg.train.half_precision)
+                             cfg.train.half_precision, stem=cfg.model.stem)
         t_score = time.perf_counter()
         scores = score_dataset(model, seeds_vars, train_ds,
                                method=cfg.score.method,

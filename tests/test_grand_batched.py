@@ -7,7 +7,9 @@ trick for dense, recomputed-x̂ reductions for BatchNorm). These tests pin it to
 with masking, on a sharded mesh, and through the ``make_score_step`` dispatch.
 """
 
+import flax.linen as nn
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -87,16 +89,14 @@ def test_sharded_equals_single_device(mesh8):
                                rtol=1e-4, atol=1e-6)
 
 
-class _PerPositionDense(__import__("flax").linen.Module):
+class _PerPositionDense(nn.Module):
     """Dense applied per spatial position ([B, S, F] input) — the weight is
     shared across positions, so Goodfellow's factored identity does not apply."""
 
     num_classes: int = 10
 
-    @__import__("flax").linen.compact
+    @nn.compact
     def __call__(self, x, *, train: bool = False, capture_features: bool = False):
-        import flax.linen as nn
-        import jax.numpy as jnp
         b = x.shape[0]
         x = x.reshape(b, -1, x.shape[-1])              # [B, S, C]
         x = nn.relu(nn.Dense(8, name="per_pos")(x))    # rank-3 Dense input
@@ -115,9 +115,6 @@ def test_per_position_dense_matches_vmap():
 
 
 def test_uncovered_parameterized_module_refuses():
-    import flax.linen as nn
-    import jax.numpy as jnp
-
     class WithGroupNorm(nn.Module):
         @nn.compact
         def __call__(self, x, *, train: bool = False):
@@ -145,3 +142,15 @@ def test_score_step_dispatch():
     train_mode = make_score_step(model, "grand", eval_mode=False, chunk=4)(
         variables, batch)
     assert np.isfinite(np.asarray(train_mode)).all()
+
+
+def test_imagenet_stem_matches_vmap():
+    """7x7 stride-2 stem + max-pool through the batched algorithm (stride>1
+    large-kernel patches; pool has no params)."""
+    model = create_model("resnet18", 10, stem="imagenet")
+    batch = _batch(4, 32, seed=6)
+    variables = _trained_stats(model, _init(model, 32), batch)
+    fast = make_grand_batched_step(model)(variables, batch)
+    ref = make_grand_step(model, chunk=2)(variables, batch)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)

@@ -79,3 +79,24 @@ def test_bfloat16_compute_fp32_params():
     assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(variables["params"]))
     logits = model.apply(variables, x, train=False)
     assert logits.dtype == jnp.float32  # logits promoted back for stable softmax
+
+
+def test_imagenet_stem_geometry():
+    """7x7/s2 + max-pool stem: 64x64 input reaches stage 1 at 16x16 (vs 64x64 for
+    the cifar stem) and still produces [B, num_classes] logits."""
+    import jax
+    import numpy as np
+    from data_diet_distributed_tpu.models import create_model
+
+    model = create_model("resnet18", 10, stem="imagenet")
+    x = np.zeros((2, 64, 64, 3), np.float32)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0), x[:1], train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    stem_kernel = variables["params"]["stem_conv"]["kernel"]
+    assert stem_kernel.shape == (7, 7, 3, 64)
+
+    import pytest
+    with pytest.raises(ValueError, match="stem"):
+        create_model("wideresnet28_10", 10, stem="imagenet")
