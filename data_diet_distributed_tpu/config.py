@@ -111,6 +111,10 @@ class TrainConfig:
     # checkpoint up to this many times.
     auto_resume_retries: int = 0
     half_precision: bool = True    # bfloat16 compute on TPU, fp32 params
+    # Upload train/test sets to HBM once and gather batches on device (epoch
+    # host->device traffic becomes one index permutation). None = auto: on for
+    # single-process meshes when the dataset fits data/pipeline.RESIDENT_MAX_BYTES.
+    device_resident_data: bool | None = None
     log_every_steps: int = 50
 
 

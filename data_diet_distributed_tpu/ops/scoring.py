@@ -66,7 +66,9 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     pos_of[ds.indices] = np.arange(n)
 
     if device_resident is None:
-        n_dev = sharder.mesh.size if sharder is not None else 1
+        # Batches shard over the 'data' axis only (model-axis devices hold
+        # replicas), so the per-device budget scales with the data axis alone.
+        n_dev = sharder.mesh.shape["data"] if sharder is not None else 1
         budget = min(n_dev * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
                      _DEVICE_RESIDENT_MAX_BYTES)
         device_resident = (len(variables_seeds) > 1

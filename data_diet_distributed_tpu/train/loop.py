@@ -23,12 +23,14 @@ from dataclasses import dataclass, field
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..checkpoint import CheckpointManager
 from ..config import Config
 from ..data.datasets import ArrayDataset
-from ..data.pipeline import BatchSharder, iterate_batches, num_batches
+from ..data.pipeline import (BatchSharder, iterate_batches, maybe_resident,
+                             num_batches)
 from ..models import create_model
 from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
@@ -64,14 +66,31 @@ def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Confi
 
 
 def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
-             batch_size: int, eval_step=None) -> dict[str, float]:
+             batch_size: int, eval_step=None, resident=None) -> dict[str, float]:
     eval_step = eval_step or make_eval_step(model)
     batch_size = sharder.global_batch_size_for(batch_size)
     totals = {"loss_sum": 0.0, "correct": 0.0, "examples": 0.0}
-    for host_batch in iterate_batches(ds, batch_size, shuffle=False):
-        m = eval_step(state, sharder(host_batch))
-        for k in totals:
-            totals[k] += float(m[k])
+    batches = (resident() if resident is not None else
+               (sharder(hb) for hb in iterate_batches(ds, batch_size,
+                                                      shuffle=False)))
+    # Dispatch ahead, fetch in bounded windows: one host round trip per window
+    # (per-scalar float() syncs are ruinous on high-latency device transports)
+    # without pinning every streamed batch in HBM at once (resident batches live
+    # on device anyway — no window needed there).
+    window = 1 << 30 if resident is not None else 8
+    pending: list[dict] = []
+
+    def flush():
+        for m in jax.device_get(pending):
+            for k in totals:
+                totals[k] += float(m[k])
+        pending.clear()
+
+    for b in batches:
+        pending.append(eval_step(state, b))
+        if len(pending) >= window:
+            flush()
+    flush()
     n = max(totals["examples"], 1.0)
     return {"loss": totals["loss_sum"] / n, "accuracy": totals["correct"] / n,
             "examples": int(n)}
@@ -112,12 +131,24 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     train_step = make_train_step(model)
     eval_step = make_eval_step(model) if test_ds is not None else None
 
+    # Device-resident epoch data: upload the (pruned) train set — and the test
+    # set, re-streamed every eval otherwise — to HBM once, in the model's compute
+    # dtype. Per-epoch host→device traffic becomes just the index permutation.
+    image_dtype = jnp.bfloat16 if cfg.train.half_precision else np.float32
+    train_resident = maybe_resident(train_ds, mesh, batch_size, image_dtype,
+                                    enabled=cfg.train.device_resident_data)
+    test_resident = None
+    if test_ds is not None:
+        test_resident = maybe_resident(
+            test_ds, mesh, sharder.global_batch_size_for(cfg.data.eval_batch_size),
+            image_dtype, enabled=cfg.train.device_resident_data)
+
     result = FitResult(state=state)
     t_start = time.perf_counter()
     try:
         _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                     sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                    saved_steps)
+                    saved_steps, train_resident, test_resident)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -127,20 +158,27 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                saved_steps=None):
+                saved_steps=None, train_resident=None, test_resident=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
+        shuffle = cfg.data.shuffle_each_epoch
+        batches = (train_resident(shuffle=shuffle, seed=cfg.train.seed,
+                                  epoch=epoch)
+                   if train_resident is not None else
+                   (sharder(hb) for hb in iterate_batches(
+                       train_ds, batch_size, shuffle=shuffle,
+                       seed=cfg.train.seed, epoch=epoch)))
         # Device scalars accumulate un-synced (async dispatch); host conversion
-        # happens once per epoch below.
+        # happens once per epoch below, in a single device_get — per-scalar
+        # float() syncs would serialize the epoch on transport latency.
         step_metrics: list[dict] = []
-        for i, host_batch in enumerate(iterate_batches(
-                train_ds, batch_size, shuffle=cfg.data.shuffle_each_epoch,
-                seed=cfg.train.seed, epoch=epoch)):
-            state, metrics = train_step(state, sharder(host_batch))
+        for i, batch in enumerate(batches):
+            state, metrics = train_step(state, batch)
             step_metrics.append(metrics)
             if (i + 1) % cfg.train.log_every_steps == 0:
                 logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
                            loss=float(metrics["loss"]))
+        step_metrics = jax.device_get(step_metrics)
         epoch_s = time.perf_counter() - epoch_t0
         examples = sum(float(m["examples"]) for m in step_metrics)
         record: dict[str, Any] = {
@@ -154,7 +192,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         if test_ds is not None and ((epoch + 1) % cfg.train.eval_every == 0
                                     or epoch + 1 == cfg.train.num_epochs):
             ev = evaluate(model, state, test_ds, sharder, cfg.data.eval_batch_size,
-                          eval_step)
+                          eval_step, resident=test_resident)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
         logger.log("epoch", tag=tag, **record)

@@ -12,13 +12,10 @@
 # or `pip install -e .` first.)
 
 # %% Setup: a mesh over every visible device, synthetic CIFAR-shaped data
-import jax
-import numpy as np
-
 from data_diet_distributed_tpu.config import load_config
 from data_diet_distributed_tpu.data.pipeline import BatchSharder
 from data_diet_distributed_tpu.models import create_model
-from data_diet_distributed_tpu.parallel.mesh import make_mesh, replicate
+from data_diet_distributed_tpu.parallel.mesh import make_mesh
 from data_diet_distributed_tpu.train.loop import fit, load_data_for
 
 # tiny_cnn keeps this runnable in ~a minute on one CPU core; on a TPU, swap in

@@ -53,3 +53,44 @@ def test_epoch_shuffle_deterministic_and_distinct():
 def test_missing_cifar_raises_cleanly(tmp_path):
     with pytest.raises(FileNotFoundError):
         load_dataset("cifar10", data_dir=str(tmp_path))
+
+
+def test_resident_batches_match_streaming(mesh8):
+    """Device-resident epoch batching must yield byte-identical batch composition
+    (order, padding, masks) to iterate_batches + BatchSharder."""
+    import jax
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import (BatchSharder,
+                                                         ResidentBatches,
+                                                         iterate_batches)
+
+    ds, _ = load_dataset("synthetic", synthetic_size=100, seed=0)  # 100 % 32 != 0
+    sharder = BatchSharder(mesh8)
+    resident = ResidentBatches(ds, mesh8, 32)
+    for shuffle, epoch in [(False, 0), (True, 0), (True, 3)]:
+        stream = [sharder(hb) for hb in iterate_batches(
+            ds, 32, shuffle=shuffle, seed=7, epoch=epoch)]
+        res = list(resident(shuffle=shuffle, seed=7, epoch=epoch))
+        assert len(stream) == len(res)
+        for sb, rb in zip(stream, res):
+            for k in ("image", "label", "index", "mask"):
+                np.testing.assert_array_equal(np.asarray(sb[k]),
+                                              np.asarray(rb[k]), err_msg=k)
+
+
+def test_maybe_resident_gating(mesh8):
+    from data_diet_distributed_tpu.data import pipeline
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+
+    ds, _ = load_dataset("synthetic", synthetic_size=64, seed=0)
+    assert pipeline.maybe_resident(ds, mesh8, 32) is not None
+    assert pipeline.maybe_resident(ds, mesh8, 32, enabled=False) is None
+    old = pipeline.RESIDENT_MAX_BYTES
+    try:
+        pipeline.RESIDENT_MAX_BYTES = 1   # auto mode respects the budget
+        assert pipeline.maybe_resident(ds, mesh8, 32) is None
+        # explicit True overrides the auto budget
+        assert pipeline.maybe_resident(ds, mesh8, 32, enabled=True) is not None
+    finally:
+        pipeline.RESIDENT_MAX_BYTES = old

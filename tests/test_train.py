@@ -3,6 +3,7 @@ and the two-phase datadiet pipeline holds its invariants end-to-end."""
 
 import jax
 import numpy as np
+import pytest
 
 from data_diet_distributed_tpu.data.datasets import load_dataset
 from data_diet_distributed_tpu.data.pipeline import BatchSharder
@@ -79,3 +80,24 @@ def test_score_ckpt_step_loads_checkpoint(tiny_cfg, tiny_ds, mesh8, tmp_path):
     for a, b in zip(jax.tree.leaves(res.state.params),
                     jax.tree.leaves(vars_list[0]["params"])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_resident_equals_streaming(tiny_cfg):
+    """Training on device-resident data must reproduce the streaming path."""
+    import copy
+    import numpy as np
+    from data_diet_distributed_tpu.train.loop import fit, load_data_for
+
+    cfg_a = copy.deepcopy(tiny_cfg)
+    cfg_a.train.device_resident_data = False
+    cfg_b = copy.deepcopy(tiny_cfg)
+    cfg_b.train.device_resident_data = True
+    train_ds, test_ds = load_data_for(cfg_a)
+    res_a = fit(cfg_a, train_ds, test_ds)
+    res_b = fit(cfg_b, train_ds, test_ds)
+    assert res_a.history[-1]["train_loss"] == pytest.approx(
+        res_b.history[-1]["train_loss"], rel=1e-5)
+    assert res_a.history[-1]["test_accuracy"] == res_b.history[-1]["test_accuracy"]
+    a = np.asarray(res_a.state.params["classifier"]["kernel"])
+    b = np.asarray(res_b.state.params["classifier"]["kernel"])
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
