@@ -54,6 +54,12 @@ class FitResult:
         return None
 
 
+def _image_dtype(cfg: Config):
+    """Upload dtype for device-resident data: the model's compute dtype (it
+    casts inputs anyway, so this halves the upload with no numeric change)."""
+    return jnp.bfloat16 if cfg.train.half_precision else np.float32
+
+
 def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Config:
     if num_epochs is None and seed is None:
         return cfg
@@ -101,7 +107,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         logger: MetricsLogger | None = None, num_epochs: int | None = None,
         seed: int | None = None, checkpoint_dir: str | None = None,
         resume_step: int | None = None, saved_steps: list[int] | None = None,
-        tag: str = "train") -> FitResult:
+        tag: str = "train", train_resident=None) -> FitResult:
     """Train a fresh model (or resume) for exactly ``num_epochs`` epochs."""
     cfg = _with_epochs(cfg, num_epochs, seed)
     mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
@@ -134,9 +140,12 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     # Device-resident epoch data: upload the (pruned) train set — and the test
     # set, re-streamed every eval otherwise — to HBM once, in the model's compute
     # dtype. Per-epoch host→device traffic becomes just the index permutation.
-    image_dtype = jnp.bfloat16 if cfg.train.half_precision else np.float32
-    train_resident = maybe_resident(train_ds, mesh, batch_size, image_dtype,
-                                    enabled=cfg.train.device_resident_data)
+    # A caller-provided ``train_resident`` (multi-seed scoring pretrains share
+    # one upload across seeds) is used as-is.
+    image_dtype = _image_dtype(cfg)
+    if train_resident is None:
+        train_resident = maybe_resident(train_ds, mesh, batch_size, image_dtype,
+                                        enabled=cfg.train.device_resident_data)
     test_resident = None
     if test_ds is not None:
         test_resident = maybe_resident(
@@ -175,6 +184,10 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         for i, batch in enumerate(batches):
             state, metrics = train_step(state, batch)
             step_metrics.append(metrics)
+            # Streaming mode: bound dispatch runahead so queued host-uploaded
+            # batches can't pile up in HBM (resident batches live there anyway).
+            if train_resident is None and (i + 1) % 8 == 0:
+                jax.device_get(metrics["examples"])
             if (i + 1) % cfg.train.log_every_steps == 0:
                 logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
                            loss=float(metrics["loss"]))
@@ -277,11 +290,18 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
                    dir=cfg.train.checkpoint_dir)
         return [replicate(variables, mesh)]
     out = []
+    # One dataset upload shared by every seed's pretrain (fit would otherwise
+    # re-upload per seed; 10-seed scoring pays host->device transfer once).
+    shared_resident = None
+    if cfg.score.pretrain_epochs > 0:
+        shared_resident = maybe_resident(
+            train_ds, mesh, sharder.global_batch_size_for(cfg.data.batch_size),
+            _image_dtype(cfg), enabled=cfg.train.device_resident_data)
     for s in cfg.score.seeds:
         if cfg.score.pretrain_epochs > 0:
             res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
                       num_epochs=cfg.score.pretrain_epochs, seed=int(s),
-                      tag=f"score_pretrain_seed{s}")
+                      tag=f"score_pretrain_seed{s}", train_resident=shared_resident)
             out.append(res.state.variables)
         else:
             model = create_model(cfg.model.arch, cfg.model.num_classes,
