@@ -60,6 +60,14 @@ def _image_dtype(cfg: Config):
     return jnp.bfloat16 if cfg.train.half_precision else np.float32
 
 
+def _train_resident(cfg: Config, ds: ArrayDataset, mesh, sharder: BatchSharder):
+    """The train-set residency policy — ONE place, used by ``fit`` and by the
+    multi-seed scoring pretrain that shares an upload across seeds."""
+    return maybe_resident(ds, mesh, sharder.global_batch_size_for(
+        cfg.data.batch_size), _image_dtype(cfg),
+        enabled=cfg.train.device_resident_data)
+
+
 def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Config:
     if num_epochs is None and seed is None:
         return cfg
@@ -142,15 +150,13 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     # dtype. Per-epoch host→device traffic becomes just the index permutation.
     # A caller-provided ``train_resident`` (multi-seed scoring pretrains share
     # one upload across seeds) is used as-is.
-    image_dtype = _image_dtype(cfg)
     if train_resident is None:
-        train_resident = maybe_resident(train_ds, mesh, batch_size, image_dtype,
-                                        enabled=cfg.train.device_resident_data)
+        train_resident = _train_resident(cfg, train_ds, mesh, sharder)
     test_resident = None
     if test_ds is not None:
         test_resident = maybe_resident(
             test_ds, mesh, sharder.global_batch_size_for(cfg.data.eval_batch_size),
-            image_dtype, enabled=cfg.train.device_resident_data)
+            _image_dtype(cfg), enabled=cfg.train.device_resident_data)
 
     result = FitResult(state=state)
     t_start = time.perf_counter()
@@ -186,8 +192,10 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
             step_metrics.append(metrics)
             # Streaming mode: bound dispatch runahead so queued host-uploaded
             # batches can't pile up in HBM (resident batches live there anyway).
-            if train_resident is None and (i + 1) % 8 == 0:
-                jax.device_get(metrics["examples"])
+            # Sync on the step ~8 back, not the newest — a sliding window keeps
+            # the pipeline full instead of draining it every 8 steps.
+            if train_resident is None and i >= 8:
+                jax.device_get(step_metrics[i - 8]["examples"])
             if (i + 1) % cfg.train.log_every_steps == 0:
                 logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
                            loss=float(metrics["loss"]))
@@ -294,9 +302,7 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     # re-upload per seed; 10-seed scoring pays host->device transfer once).
     shared_resident = None
     if cfg.score.pretrain_epochs > 0:
-        shared_resident = maybe_resident(
-            train_ds, mesh, sharder.global_batch_size_for(cfg.data.batch_size),
-            _image_dtype(cfg), enabled=cfg.train.device_resident_data)
+        shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
     for s in cfg.score.seeds:
         if cfg.score.pretrain_epochs > 0:
             res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
