@@ -147,14 +147,45 @@ def _matrix_grad_norm_sq(p: jax.Array, go: jax.Array) -> jax.Array:
     return jnp.sum(m * m, axis=(1, 2))
 
 
-def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
+def _explicit_padding(padding, x: jax.Array, g: jax.Array, rec: dict):
+    """Resolve string paddings to explicit pairs using XLA's SAME semantics."""
+    if not isinstance(padding, str):
+        return padding
+    if padding == "VALID":
+        return ((0, 0), (0, 0))
+    out = []
+    for d in (1, 2):
+        total = max((g.shape[d] - 1) * rec["strides"][d - 1]
+                    + rec["kernel_size"][d - 1] - x.shape[d], 0)
+        out.append((total // 2, total - total // 2))
+    return tuple(out)
+
+
+def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
+                  use_pallas: bool = False) -> jax.Array:
     """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
     batch = x.shape[0]
+    s = int(np_prod(g.shape[1:-1]))
+    f = int(np_prod(rec["kernel_size"])) * x.shape[-1]
+    k = g.shape[-1]
+    gram = s * (f + k) < f * k
+    if use_pallas and not gram:
+        from .pallas_kernels import (conv_grad_norm_pallas_fits,
+                                     conv_grad_norm_sq_pallas)
+        pad = _explicit_padding(rec["padding"], x, g, rec)
+        if conv_grad_norm_pallas_fits(x.shape, g.shape, rec["kernel_size"],
+                                      rec["strides"], x.dtype.itemsize):
+            contrib = conv_grad_norm_sq_pallas(
+                x, g, tuple(rec["kernel_size"]), tuple(rec["strides"]), pad)
+            if rec["use_bias"]:
+                contrib = contrib + _sq(
+                    jnp.sum(g.astype(_F32).reshape(batch, s, -1), axis=1),
+                    axis=-1)
+            return contrib
     patches = jax.lax.conv_general_dilated_patches(
         x, filter_shape=rec["kernel_size"], window_strides=rec["strides"],
         padding=rec["padding"],
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    s = int(np_prod(g.shape[1:-1]))
     contrib = _matrix_grad_norm_sq(patches.reshape(batch, s, patches.shape[-1]),
                                    g.reshape(batch, s, g.shape[-1]))
     if rec["use_bias"]:
@@ -202,8 +233,12 @@ def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array
     return contrib
 
 
-def batched_grand_scores(model, variables, image, label, mask) -> jax.Array:
-    """Exact per-example GraNd over all parameters, fully batched. [B] <- batch."""
+def batched_grand_scores(model, variables, image, label, mask,
+                         use_pallas: bool = False) -> jax.Array:
+    """Exact per-example GraNd over all parameters, fully batched. [B] <- batch.
+
+    ``use_pallas`` routes large-S conv layers through the fused
+    ``conv_grad_norm_sq_pallas`` kernel (no patch/M materialization in HBM)."""
     from .scores import cross_entropy  # local import: scores.py imports this module
 
     records: list[dict] = []
@@ -253,7 +288,7 @@ def batched_grand_scores(model, variables, image, label, mask) -> jax.Array:
         x = _leaf(captures, rec["path"], "x")   # sow reduce_fn stores the raw array
         g = _leaf(cotangents, rec["path"], "y")
         if rec["kind"] == "conv":
-            norm_sq = norm_sq + _conv_contrib(rec, x, g)
+            norm_sq = norm_sq + _conv_contrib(rec, x, g, use_pallas)
         elif rec["kind"] == "dense":
             norm_sq = norm_sq + _dense_contrib(rec, x, g)
         else:

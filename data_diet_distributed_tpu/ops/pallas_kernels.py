@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the scoring hot path.
 
-Two fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
+Three fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
 
 * ``el2n_pallas`` — fused ``softmax -> subtract one-hot -> row L2 norm -> mask``
   over logits. One VMEM round-trip instead of four HBM-materialized intermediates.
@@ -9,11 +9,22 @@ Two fused kernels (see /opt/skills/guides/pallas_guide.md for the API convention
   MXU against the classifier weights and the score math runs on the VPU before
   logits ever leave VMEM. The model's own Dense head output goes unused and is
   dead-code-eliminated under jit, so the classifier matmul happens exactly once.
+* ``conv_grad_norm_sq_pallas`` — the batched-GraNd conv hot loop
+  (``grand_batched.py``): per-example Frobenius norm² of the conv weight
+  gradient ``P_iᵀ G_i`` WITHOUT materializing the im2col patches or the [F, K]
+  gradient in HBM. Key identity: writing ``M_o = Σ_s x_i[s·stride + o] g_i[s]``
+  for each kernel offset ``o``, the full norm decomposes as
+  ``‖∂W‖² = Σ_o ‖M_o‖²`` — each ``M_o`` is one small [C, K] MXU contraction over
+  output positions, accumulated and squared entirely in VMEM. HBM traffic is
+  exactly one read of ``x`` and ``g`` and one [B] write (the XLA patch-einsum
+  path writes+reads a 9×-expanded patch tensor plus a [B, F, K] float32 M).
+  Strided convs are decomposed into ``stride²`` unit-stride phase sub-problems
+  (each offset belongs to exactly one phase; Mosaic rejects strided 4D slices).
 
-Both kernels tile the batch dimension (``TILE_B`` rows per grid step, fp32-aligned)
-and keep the class dimension whole (Mosaic pads the lane dimension internally).
-Padded batch rows carry ``mask == 0`` and score 0. On non-TPU backends the kernels
-run in interpreter mode, so every test exercises the same code path CI runs.
+All kernels tile the batch dimension (fp32-aligned tiles) and keep channel
+dimensions whole (Mosaic pads the lane dimension internally). Padded batch rows
+carry ``mask == 0`` and score 0. On non-TPU backends the kernels run in
+interpreter mode, so every test exercises the same code path CI runs.
 """
 
 from __future__ import annotations
@@ -80,6 +91,123 @@ def el2n_pallas(logits: jax.Array, labels: jax.Array, mask: jax.Array,
         interpret=_auto_interpret(interpret),
     )(logits, labels2, mask2)
     return out[:b, 0]
+
+
+# --------------------------------------------------------------------------
+# Fused conv weight-grad-norm kernel (the batched-GraNd hot loop).
+# --------------------------------------------------------------------------
+
+_CONV_VMEM_BUDGET = 10 << 20   # bytes per grid step; v5e VMEM is ~16 MiB
+
+
+def _conv_norm_kernel(kh, kw, x_ref, g_ref, out_ref):
+    """Unit-stride offsets: out[b] = Σ_{o<kh×kw} ‖Σ_s x[b, s+o] g[b, s]‖²_F."""
+    xb = x_ref[...]                       # [TB, Hp, Wp, C]
+    gb = g_ref[...]                       # [TB, Ho, Wo, K]
+    tb, ho, wo, k = gb.shape
+    g2 = gb.reshape(tb, ho * wo, k)
+    total = jnp.zeros((tb, 1), jnp.float32)
+    for oy in range(kh):
+        for ox in range(kw):
+            xs = xb[:, oy:oy + ho, ox:ox + wo, :]
+            m = jax.lax.dot_general(       # [TB, C, K]: contraction over S
+                xs.reshape(tb, ho * wo, xs.shape[-1]), g2,
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            msq = jnp.sum(m * m, axis=2)   # keep ranks >= 2 for Mosaic layouts
+            total = total + jnp.sum(msq, axis=1, keepdims=True)
+    out_ref[...] = total
+
+
+def _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) -> int:
+    """Largest batch tile whose working set fits the VMEM budget (0 = none)."""
+    lane = 128
+    cpad, kpad = -(-c // lane) * lane, -(-k // lane) * lane
+    per_ex = (hp * wp * cpad + ho * wo * kpad) * itemsize + cpad * kpad * 4
+    for tile in (8, 4, 2, 1):
+        if 2 * tile * per_ex <= _CONV_VMEM_BUDGET:   # ×2: double-buffer margin
+            return tile
+    return 0
+
+
+def _unit_stride_norm_sq(x_pad, g, kh, kw, interpret):
+    """One pallas_call: all (kh, kw) offsets at unit stride. x_pad [B,Hp,Wp,C]
+    must satisfy Hp >= kh-1+Ho, Wp >= kw-1+Wo."""
+    b, hp, wp, c = x_pad.shape
+    ho, wo, k = g.shape[1:]
+    tile = _conv_tile_b(hp, wp, c, ho, wo, k, x_pad.dtype.itemsize)
+    assert tile > 0, "caller must check conv_grad_norm_pallas_fits first"
+    (x_pad, g), b_pad = _pad_batch([x_pad, g], b, tile)
+    out = pl.pallas_call(
+        functools.partial(_conv_norm_kernel, kh, kw),
+        grid=(b_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, hp, wp, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, ho, wo, k), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        interpret=_auto_interpret(interpret),
+    )(x_pad, g)
+    return out[:b, 0]
+
+
+def _grow(x_pad, min_h, min_w):
+    """Zero-pad the spatial dims up to (min_h, min_w); extra rows are never read
+    at offsets that matter, they only make contiguous slices well-formed."""
+    ph = max(0, min_h - x_pad.shape[1])
+    pw = max(0, min_w - x_pad.shape[2])
+    if ph or pw:
+        x_pad = jnp.pad(x_pad, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    return x_pad
+
+
+def conv_grad_norm_pallas_fits(x_shape, g_shape, kernel_size, strides,
+                               itemsize: int = 2) -> bool:
+    """Whether the fused kernel's working set fits VMEM for this layer."""
+    kh, kw = kernel_size
+    sy, sx = strides
+    ho, wo, k = g_shape[1:]
+    hp = (kh - 1) // sy + ho + 1
+    wp = (kw - 1) // sx + wo + 1
+    c = x_shape[-1]
+    return _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) > 0
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_size", "strides", "padding",
+                                             "interpret"))
+def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
+                             padding, interpret: bool | None = None) -> jax.Array:
+    """[B] ⟵ ‖per-example conv weight gradient‖²_F, fully fused in VMEM.
+
+    ``x`` [B, H, W, C] is the conv input, ``g`` [B, Ho, Wo, K] the per-example
+    cotangent at the conv output; ``padding`` is explicit ((lo,hi),(lo,hi)).
+    Strided convs run as ``sy*sx`` unit-stride phase calls: offset (oy, ox)
+    belongs to phase (oy % sy, ox % sx) and becomes offset (oy//sy, ox//sx) on
+    the phase-strided input — the offsets of one phase are contiguous, so each
+    phase is a smaller unit-stride kernel.
+    """
+    kh, kw = kernel_size
+    sy, sx = strides
+    ho, wo, _ = g.shape[1:]
+    x_pad = jnp.pad(x, ((0, 0), padding[0], padding[1], (0, 0)))
+    if sy == 1 and sx == 1:
+        return _unit_stride_norm_sq(_grow(x_pad, kh - 1 + ho, kw - 1 + wo),
+                                    g, kh, kw, interpret)
+    total = jnp.zeros(x.shape[0], jnp.float32)
+    for py in range(sy):
+        for px in range(sx):
+            khp = len(range(py, kh, sy))
+            kwp = len(range(px, kw, sx))
+            if khp == 0 or kwp == 0:
+                continue
+            x_phase = x_pad[:, py::sy, px::sx, :]      # phase view (XLA slice)
+            x_phase = _grow(x_phase, khp - 1 + ho, kwp - 1 + wo)
+            total = total + _unit_stride_norm_sq(x_phase, g, khp, kwp, interpret)
+    return total
 
 
 def _gll_kernel(feats_ref, w_ref, b_ref, labels_ref, mask_ref, out_ref):

@@ -109,7 +109,7 @@ def resolve_use_pallas(use_pallas: bool | None) -> bool:
 
 @functools.cache
 def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
-                   use_pallas: bool | None = False):
+                   use_pallas: bool | None = None):
     """Forward-only EL2N over a (possibly mesh-sharded) batch."""
     use_pallas = resolve_use_pallas(use_pallas)
 
@@ -125,7 +125,7 @@ def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
 @functools.cache
 def make_grand_last_layer_step(model, mesh: Mesh | None = None,
                                eval_mode: bool = True,
-                               use_pallas: bool | None = False):
+                               use_pallas: bool | None = None):
     use_pallas = resolve_use_pallas(use_pallas)
 
     def local_scores(variables, image, label, mask):
@@ -145,7 +145,7 @@ def make_grand_last_layer_step(model, mesh: Mesh | None = None,
 @functools.cache
 def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
                     data_axis: str = "data", eval_mode: bool = True,
-                    use_pallas: bool | None = False):
+                    use_pallas: bool | None = None):
     """Full GraNd: per-example gradient norm over ALL parameters.
 
     Inside ``shard_map`` each device sees its local slice of the batch; the slice is
@@ -185,23 +185,28 @@ def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
 
 @functools.cache
 def make_grand_batched_step(model, mesh: Mesh | None = None,
-                            data_axis: str = "data"):
+                            data_axis: str = "data",
+                            use_pallas: bool | None = None):
     """Full GraNd via the batched exact algorithm (``grand_batched.py``): one
     batched forward + one backward w.r.t. per-layer output perturbations, then
     closed-form per-layer norm contractions — no per-example backwards, so the
     MXU sees large batched matmuls instead of batch-1 convolutions. Eval-mode
-    only (train-mode BatchNorm couples examples; see the module docstring)."""
+    only (train-mode BatchNorm couples examples; see the module docstring).
+    ``use_pallas`` selects the fused conv-grad-norm kernel for the large-S
+    conv layers (None = auto: on for TPU backends)."""
     from .grand_batched import batched_grand_scores
+    use_pallas = resolve_use_pallas(use_pallas)
 
     def local_scores(variables, image, label, mask):
-        return batched_grand_scores(model, variables, image, label, mask)
+        return batched_grand_scores(model, variables, image, label, mask,
+                                    use_pallas=use_pallas)
 
     return _wrap(local_scores, mesh, data_axis)
 
 
 @functools.cache
 def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 32,
-                    eval_mode: bool = True, use_pallas: bool | None = False):
+                    eval_mode: bool = True, use_pallas: bool | None = None):
     """Factory keyed by config string (el2n | grand | grand_vmap |
     grand_last_layer). ``grand`` runs the batched exact algorithm in eval mode
     and falls back to ``vmap(grad)`` for train-mode (reference-quirk) scoring;
@@ -211,7 +216,7 @@ def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 3
                               use_pallas=use_pallas)
     if method == "grand":
         if eval_mode:
-            return make_grand_batched_step(model, mesh)
+            return make_grand_batched_step(model, mesh, use_pallas=use_pallas)
         return make_grand_step(model, mesh, chunk=chunk, eval_mode=eval_mode,
                                use_pallas=use_pallas)
     if method == "grand_vmap":
