@@ -30,7 +30,8 @@ class CheckpointManager:
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False),
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=True),
         )
 
     def save(self, step: int, state: "TrainState",
@@ -40,6 +41,13 @@ class CheckpointManager:
         composite = {"state": ocp.args.StandardSave(payload)}
         if metrics:
             composite["metrics"] = ocp.args.JsonSave(metrics)
+        # Saves are ASYNC: serialization overlaps the next epoch's compute
+        # (Orbax snapshots the arrays before returning, so donation/mutation of
+        # ``state`` afterwards is safe). Any still-running previous save is
+        # waited on here (not at the end of this one) — the stall shrinks from
+        # full-serialization-per-save to only what the intervening epoch didn't
+        # already cover. Readers (latest_step/all_steps/restore/close) barrier.
+        self._mngr.wait_until_finished()
         if step in self._mngr.all_steps():
             # A stale checkpoint from an earlier run sharing this directory (same
             # step numbering) — overwrite it; Orbax otherwise raises
@@ -49,17 +57,19 @@ class CheckpointManager:
         # directory's latest step, so a stale HIGHER-numbered checkpoint would
         # otherwise swallow every save this run makes.
         self._mngr.save(step, args=ocp.args.Composite(**composite), force=True)
-        self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:
+        self._mngr.wait_until_finished()
         return self._mngr.latest_step()
 
     def all_steps(self) -> list[int]:
+        self._mngr.wait_until_finished()
         return list(self._mngr.all_steps())
 
     def restore(self, state: "TrainState", step: int | None = None) -> "TrainState":
         """Restore into (the abstract shape of) ``state`` — exact resume including
         optimizer state and step counter."""
+        self._mngr.wait_until_finished()   # an in-flight async save may be it
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
@@ -81,4 +91,5 @@ class CheckpointManager:
         return {"params": restored.params, "batch_stats": restored.batch_stats}
 
     def close(self) -> None:
+        self._mngr.wait_until_finished()
         self._mngr.close()

@@ -83,7 +83,7 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
         from .data.pipeline import BatchSharder
         from .models import create_model
         from .ops.scoring import score_dataset
-        from .parallel.mesh import make_mesh
+        from .parallel.mesh import is_primary, make_mesh
         from .train.loop import load_data_for, score_variables_for_seeds
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
@@ -99,7 +99,8 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
                                eval_mode=cfg.score.eval_mode,
                                use_pallas=cfg.score.use_pallas)
         out = f"{cfg.train.checkpoint_dir}_scores.npz"
-        np.savez(out, scores=scores, indices=train_ds.indices)
+        if is_primary():   # every process holds the full scores; one writes
+            np.savez(out, scores=scores, indices=train_ds.indices)
         logger.log("scores_saved", path=out, n=len(scores),
                    mean=float(scores.mean()), std=float(scores.std()))
 

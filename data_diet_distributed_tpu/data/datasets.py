@@ -22,10 +22,14 @@ from dataclasses import dataclass, replace
 
 import numpy as np
 
-# Channel statistics identical to the reference transform (data/loader.py:8-11) so
-# score parity against the torch oracle is exact at the input layer.
+# Channel statistics identical to the reference transform (data/loader.py:8-11),
+# including its folklore std values (0.2023, 0.1994, 0.2010) — which are NOT the
+# true per-pixel stds of CIFAR-10 (~0.2470, 0.2435, 0.2616) but what the
+# reference normalizes with. Bit-matching the reference's inputs is what the
+# BASELINE score-parity target is measured against, so the reference's numbers
+# win over the "correct" ones.
 CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
-CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+CIFAR10_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
 CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
 CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
 

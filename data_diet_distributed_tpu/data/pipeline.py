@@ -78,6 +78,13 @@ class BatchSharder:
         nprocs = jax.process_count()
         for key, value in batch.items():
             if nprocs > 1:
+                # Unequal slices would silently mis-shard (device d would get
+                # rows meant for d±1); global_batch_size_for rounds to nprocs
+                # divisibility, so anything else here is a caller bug.
+                if value.shape[0] % nprocs != 0:
+                    raise ValueError(
+                        f"global batch of {value.shape[0]} rows does not divide "
+                        f"over {nprocs} processes; use global_batch_size_for")
                 pid = jax.process_index()
                 local = np.array_split(value, nprocs, axis=0)[pid]
                 out[key] = jax.make_array_from_process_local_data(
@@ -87,8 +94,11 @@ class BatchSharder:
         return out
 
     def global_batch_size_for(self, requested: int) -> int:
-        """Round a batch size up to mesh divisibility (data axis x processes)."""
+        """Round a batch size up to mesh divisibility: the data axis (device
+        sharding) and the process count (per-process contiguous slices)."""
         div = self.mesh.shape["data"]
+        nprocs = jax.process_count()
+        div = div * nprocs // np.gcd(div, nprocs)   # lcm
         return ((requested + div - 1) // div) * div
 
 

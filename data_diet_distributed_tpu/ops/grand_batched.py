@@ -77,6 +77,11 @@ def _record_for(mod) -> dict:
     path = tuple(mod.path)
     if isinstance(mod, nn.Conv):
         n = len(mod.kernel_size)
+        if n != 2:
+            raise NotImplementedError(
+                "batched GraNd supports 2-D convolutions only (module "
+                f"{'/'.join(path)} has {n}-D kernel); use the grand_vmap "
+                "score method")
         if mod.feature_group_count != 1:
             raise NotImplementedError(
                 "batched GraNd supports feature_group_count=1 convolutions only "
@@ -86,10 +91,19 @@ def _record_for(mod) -> dict:
             raise NotImplementedError(
                 f"batched GraNd does not support dilated convolutions "
                 f"(module {'/'.join(path)}); use the grand_vmap score method")
+        padding = _canon_padding(mod.padding, n)
+        if isinstance(padding, str) and padding not in ("SAME", "VALID"):
+            # _explicit_padding implements XLA's SAME arithmetic only; any other
+            # string (SAME_LOWER, CIRCULAR, ...) would silently compute wrong
+            # norms — refuse loudly like the grouped/dilated-conv guards.
+            raise NotImplementedError(
+                f"batched GraNd supports SAME/VALID/explicit conv padding only "
+                f"(module {'/'.join(path)} has {padding!r}); use the grand_vmap "
+                "score method")
         return {"kind": "conv", "path": path,
                 "kernel_size": tuple(mod.kernel_size),
                 "strides": _canon_tuple(mod.strides, n),
-                "padding": _canon_padding(mod.padding, n),
+                "padding": padding,
                 "use_bias": mod.use_bias}
     if isinstance(mod, nn.Dense):
         return {"kind": "dense", "path": path, "use_bias": mod.use_bias}

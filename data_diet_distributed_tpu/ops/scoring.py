@@ -42,7 +42,7 @@ _DEVICE_RESIDENT_MAX_BYTES = 4 << 30
 def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   method: str = "el2n", batch_size: int = 512,
                   sharder: BatchSharder | None = None, chunk: int = 32,
-                  eval_mode: bool = True, use_pallas: bool | None = False,
+                  eval_mode: bool = True, use_pallas: bool | None = None,
                   score_step=None, device_resident: bool | None = None) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 

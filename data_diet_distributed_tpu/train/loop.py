@@ -34,7 +34,7 @@ from ..data.pipeline import (BatchSharder, iterate_batches, maybe_resident,
 from ..models import create_model
 from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
-from ..parallel.mesh import make_mesh, replicate
+from ..parallel.mesh import is_primary, make_mesh, replicate
 from ..pruning import select_indices
 from .state import TrainState, create_train_state
 from .steps import make_eval_step, make_train_step
@@ -83,6 +83,11 @@ def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
              batch_size: int, eval_step=None, resident=None) -> dict[str, float]:
     eval_step = eval_step or make_eval_step(model)
     batch_size = sharder.global_batch_size_for(batch_size)
+    if resident is not None and resident.batch_size != batch_size:
+        raise ValueError(
+            f"evaluate: resident batches were built at batch size "
+            f"{resident.batch_size} but batch_size={batch_size} was requested; "
+            "rebuild the ResidentBatches or pass the matching size")
     totals = {"loss_sum": 0.0, "correct": 0.0, "examples": 0.0}
     batches = (resident() if resident is not None else
                (sharder(hb) for hb in iterate_batches(ds, batch_size,
@@ -258,7 +263,19 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
             attempt += 1
             if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
                 raise
-            resume_step = max(saved_steps) if saved_steps else None
+            # Saves are async: a step lands in saved_steps when dispatched, but
+            # the write may be the very thing that failed. Resume only from
+            # steps that are finalized on disk (Orbax commits atomically, so
+            # all_steps() lists exactly the durable ones).
+            resume_step = None
+            if saved_steps:
+                mngr = CheckpointManager(checkpoint_dir,
+                                         max_to_keep=cfg.train.keep_checkpoints)
+                try:
+                    durable = set(mngr.all_steps()) & set(saved_steps)
+                finally:
+                    mngr.close()
+                resume_step = max(durable) if durable else None
             logger.log("recovery", attempt=attempt,
                        retries_left=cfg.train.auto_resume_retries - attempt,
                        resume=cfg.train.resume or resume_step is not None,
@@ -346,8 +363,9 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         score_s = time.perf_counter() - t_score
         kept = select_indices(scores, train_ds.indices, cfg.prune.sparsity,
                               keep=cfg.prune.keep, seed=cfg.train.seed)
-        np.savez(f"{cfg.train.checkpoint_dir}_scores.npz", scores=scores,
-                 indices=train_ds.indices, kept=kept)
+        if is_primary():   # every process holds the full scores; one writes
+            np.savez(f"{cfg.train.checkpoint_dir}_scores.npz", scores=scores,
+                     indices=train_ds.indices, kept=kept)
         logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
                    score_s=round(score_s, 3),
                    score_examples_per_s=len(train_ds) * len(seeds_vars) / score_s)

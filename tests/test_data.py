@@ -55,6 +55,45 @@ def test_missing_cifar_raises_cleanly(tmp_path):
         load_dataset("cifar10", data_dir=str(tmp_path))
 
 
+def _write_fake_cifar10(data_dir, n_per_batch=4, seed=0):
+    """Standard CIFAR-10 python-pickle layout with random uint8 images."""
+    import os
+    import pickle
+
+    rng = np.random.default_rng(seed)
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    os.makedirs(root, exist_ok=True)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = rng.integers(0, 256, size=(n_per_batch, 3072), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n_per_batch).astype(int).tolist()
+        with open(os.path.join(root, name), "wb") as fh:
+            pickle.dump({b"data": data, b"labels": labels}, fh)
+
+
+def test_cifar10_normalization_bitmatches_reference(tmp_path):
+    """Inputs must bit-match the reference transform (reference data/loader.py:8-11:
+    ToTensor + Normalize((0.4914,0.4822,0.4465), (0.2023,0.1994,0.2010))) —
+    including the reference's folklore stds, which are NOT CIFAR's true stds."""
+    torch = pytest.importorskip("torch")  # oracle only; suite must survive without it
+
+    _write_fake_cifar10(str(tmp_path))
+    train, _ = load_dataset("cifar10", data_dir=str(tmp_path))
+
+    import os
+    import pickle
+    with open(os.path.join(str(tmp_path), "cifar-10-batches-py",
+                           "data_batch_1"), "rb") as fh:
+        raw = pickle.load(fh, encoding="bytes")[b"data"]
+    # Reference semantics, computed independently with torch: uint8 CHW / 255,
+    # then per-channel (x - mean) / std, all in float32.
+    chw = torch.from_numpy(np.asarray(raw, np.uint8).reshape(-1, 3, 32, 32))
+    x = chw.to(torch.float32) / 255.0
+    mean = torch.tensor([0.4914, 0.4822, 0.4465]).view(1, 3, 1, 1)
+    std = torch.tensor([0.2023, 0.1994, 0.2010]).view(1, 3, 1, 1)
+    ref = ((x - mean) / std).permute(0, 2, 3, 1).numpy()  # NCHW -> NHWC
+    np.testing.assert_array_equal(train.images[: len(ref)], ref)
+
+
 def test_resident_batches_match_streaming(mesh8):
     """Device-resident epoch batching must yield byte-identical batch composition
     (order, padding, masks) to iterate_batches + BatchSharder."""
