@@ -69,9 +69,26 @@ class BatchSharder:
     and XLA moves nothing unless a collective requires it.
     """
 
-    def __init__(self, mesh: Mesh, data_axis: str = "data"):
+    def __init__(self, mesh: Mesh, data_axis: str = "data",
+                 axes: tuple[str, ...] | None = None):
+        """``axes`` (default ``(data_axis,)``) are the mesh axes the batch dim
+        shards over, in mesh order. Training shards over ``data`` only (model-axis
+        devices hold batch replicas and split the TP classifier); scoring has no
+        tensor-parallel compute worth replicating for, so it flattens the whole
+        mesh — see ``flat``."""
         self.mesh = mesh
-        self.sharding = NamedSharding(mesh, P(data_axis))
+        self.axes = tuple(axes) if axes is not None else (data_axis,)
+        spec = P(self.axes if len(self.axes) > 1 else self.axes[0])
+        self.sharding = NamedSharding(mesh, spec)
+        self._shards = int(np.prod([mesh.shape[a] for a in self.axes]))
+
+    @classmethod
+    def flat(cls, mesh: Mesh) -> "BatchSharder":
+        """Shard the batch over EVERY mesh axis — the scoring layout: per-example
+        forward(+cotangent) work is embarrassingly data-parallel, so a ``model``
+        axis would only compute replicas; flattening makes all ``data x model``
+        devices score distinct examples (params re-replicate once per pass)."""
+        return cls(mesh, axes=tuple(mesh.axis_names))
 
     def __call__(self, batch: Batch) -> dict[str, jax.Array]:
         out = {}
@@ -94,11 +111,11 @@ class BatchSharder:
         return out
 
     def global_batch_size_for(self, requested: int) -> int:
-        """Round a batch size up to mesh divisibility: the data axis (device
+        """Round a batch size up to mesh divisibility: the sharded axes (device
         sharding) and the process count (per-process contiguous slices)."""
-        div = self.mesh.shape["data"]
+        div = self._shards
         nprocs = jax.process_count()
-        div = div * nprocs // np.gcd(div, nprocs)   # lcm
+        div = int(div * nprocs // np.gcd(div, nprocs))   # lcm
         return ((requested + div - 1) // div) * div
 
 

@@ -73,7 +73,12 @@ def _forward(model, variables, images, *, eval_mode: bool, capture_features=Fals
 
 def _wrap(local_scores, mesh: Mesh | None, data_axis: str = "data"):
     """Lift a per-device ``(variables, image, label, mask) -> scores`` function to a
-    jitted whole-batch step, sharded over ``data`` when a multi-device mesh is given.
+    jitted whole-batch step, sharded over the FLATTENED mesh (every axis, ``data``
+    first) when a multi-device mesh is given: per-example scoring has no
+    tensor-parallel compute worth keeping a ``model`` axis idle for, so on a TP
+    mesh all ``data x model`` devices score distinct examples. Params enter with
+    in_spec ``P()`` — jit re-replicates a TP-sharded classifier once per pass
+    (~MBs over ICI, amortized over the whole dataset).
 
     check_vma=False on the shard_map: with VMA tracking on, ``jax.grad`` taken INSIDE
     the body w.r.t. the replicated (P()) params auto-inserts a psum over 'data' to
@@ -89,10 +94,12 @@ def _wrap(local_scores, mesh: Mesh | None, data_axis: str = "data"):
                                 batch["mask"])
         return step
 
+    axes = (data_axis, *[a for a in mesh.axis_names if a != data_axis])
+    spec = P(axes if len(axes) > 1 else axes[0])
     sharded = jax.shard_map(
         local_scores, mesh=mesh,
-        in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
-        out_specs=P(data_axis), check_vma=False)
+        in_specs=(P(), spec, spec, spec),
+        out_specs=spec, check_vma=False)
 
     @jax.jit
     def step(variables, batch):

@@ -53,6 +53,11 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     ``n_seeds`` times.
     """
     mesh = sharder.mesh if sharder is not None else None
+    if sharder is not None and len(sharder.axes) < len(mesh.axis_names):
+        # Scoring flattens the whole mesh (the score step shards batches over
+        # every axis — ops/scores._wrap): re-sharder so host placement matches
+        # the step's layout and batch sizes round to all-device divisibility.
+        sharder = BatchSharder.flat(mesh)
     if score_step is None:
         score_step = make_score_step(model, method, mesh, chunk=chunk,
                                      eval_mode=eval_mode, use_pallas=use_pallas)
@@ -66,9 +71,9 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     pos_of[ds.indices] = np.arange(n)
 
     if device_resident is None:
-        # Batches shard over the 'data' axis only (model-axis devices hold
-        # replicas), so the per-device budget scales with the data axis alone.
-        n_dev = sharder.mesh.shape["data"] if sharder is not None else 1
+        # Batches shard over every flattened mesh axis, so the per-device
+        # budget scales with the full device count.
+        n_dev = sharder.mesh.size if sharder is not None else 1
         budget = min(n_dev * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
                      _DEVICE_RESIDENT_MAX_BYTES)
         device_resident = (len(variables_seeds) > 1

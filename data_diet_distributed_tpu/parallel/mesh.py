@@ -87,23 +87,47 @@ def param_specs(params, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def _path_names(path) -> tuple:
+    return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
 def place_state(state, mesh: Mesh):
-    """Device-place a TrainState: params (and matching optimizer slots) per
-    ``param_specs``; everything else replicated."""
+    """Device-place a TrainState: params AND their optimizer slots per
+    ``param_specs``; everything else replicated. This is the production placement
+    used by ``fit`` (the reference's equivalent surface is DDP model wrapping,
+    ``ddp.py:133-164``); with ``model_axis == 1`` it degenerates to ``replicate``.
+    """
+    tp = mesh.shape[MODEL_AXIS] > 1
+    if not tp:
+        return replicate(state, mesh)
     specs = param_specs(state.params, mesh)
+    by_path = {
+        _path_names(path): spec for path, spec in
+        jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]}
 
     def put(tree, spec_tree):
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree)
 
+    def opt_spec(path, leaf):
+        # Optimizer slots mirror the param tree somewhere under their own
+        # wrapper (optax TraceState.trace['classifier']['kernel'], ...): match
+        # the longest path suffix against a param path so momentum for a
+        # TP-sharded kernel is sharded identically — a replicated slot would
+        # make every SGD update all-gather the gradient back.
+        names = _path_names(path)
+        for i in range(len(names)):
+            if names[i:] in by_path:
+                return by_path[names[i:]]
+        return P()
+
     params = put(state.params, specs)
-    # Optimizer slots and batch stats stay replicated; under jit GSPMD reshards where
-    # the TP'd classifier update needs it. (SGD momentum for the small heads involved
-    # is bytes, not a memory concern.)
+    opt_state = put(state.opt_state, jax.tree_util.tree_map_with_path(
+        opt_spec, state.opt_state))
     rest = jax.device_put(
-        {"opt_state": state.opt_state, "batch_stats": state.batch_stats,
-         "step": state.step}, replicated(mesh))
-    return state.replace(params=params, opt_state=rest["opt_state"],
+        {"batch_stats": state.batch_stats, "step": state.step}, replicated(mesh))
+    return state.replace(params=params, opt_state=opt_state,
                          batch_stats=rest["batch_stats"], step=rest["step"])
 
 

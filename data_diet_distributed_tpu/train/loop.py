@@ -34,7 +34,7 @@ from ..data.pipeline import (BatchSharder, iterate_batches, maybe_resident,
 from ..models import create_model
 from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
-from ..parallel.mesh import is_primary, make_mesh, replicate
+from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
 from ..pruning import select_indices
 from .state import TrainState, create_train_state
 from .steps import make_eval_step, make_train_step
@@ -134,7 +134,11 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     rng = jax.random.key(cfg.train.seed)
     state = create_train_state(cfg, rng, steps_per_epoch,
                                sample_shape=(1, *train_ds.images.shape[1:]))
-    state = replicate(state, mesh)
+    # Production placement: replicated under pure DP; classifier (and its
+    # optimizer slots) tensor-parallel over 'model' when the mesh has one —
+    # the train/eval jits then partition the head matmul and gather logits
+    # via compiler-inserted collectives.
+    state = place_state(state, mesh)
 
     ckpt = None
     start_epoch = 0

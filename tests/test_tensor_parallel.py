@@ -1,0 +1,136 @@
+"""Tensor parallelism in the PRODUCTION path (VERDICT r2 #1).
+
+The ``model`` mesh axis shards the classifier head (kernel over output
+features, ``parallel/mesh.py:param_specs``); ``fit`` places state through
+``place_state`` so a ``mesh.model_axis=2`` config trains with the head
+actually sharded, and scoring flattens the mesh so every device scores
+distinct examples. These tests pin the invariant that a 4x2 TP mesh computes
+the SAME numbers as the 8x1 DP mesh (and hence, transitively through
+test_distributed.py, as a single device).
+
+Reference surface being subsumed: the production DDP wrapper
+(``/root/reference/ddp.py:133-164``) — its only parallelism was data; the TP
+axis is the TPU-native extension the wide-classifier configs need.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from data_diet_distributed_tpu.config import MeshConfig
+from data_diet_distributed_tpu.data.pipeline import BatchSharder
+from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.ops.scoring import score_dataset
+from data_diet_distributed_tpu.parallel.mesh import (MODEL_AXIS, make_mesh,
+                                                     place_state, replicate)
+from data_diet_distributed_tpu.train.state import create_train_state
+from data_diet_distributed_tpu.train.steps import make_eval_step, make_train_step
+
+
+def _mesh42():
+    return make_mesh(MeshConfig(data_axis=4, model_axis=2))
+
+
+def _host_batch(ds, n=64):
+    return {"image": ds.images[:n], "label": ds.labels[:n],
+            "index": ds.indices[:n], "mask": np.ones(n, np.float32)}
+
+
+def _spec_of(arr) -> P:
+    return arr.sharding.spec
+
+
+def test_place_state_shards_classifier_and_momentum(tiny_cfg):
+    mesh = _mesh42()
+    state = create_train_state(tiny_cfg, jax.random.key(0), steps_per_epoch=4)
+    state = place_state(state, mesh)
+    kernel = state.params["classifier"]["kernel"]
+    assert _spec_of(kernel) == P(None, MODEL_AXIS)
+    assert not kernel.sharding.is_fully_replicated
+    assert _spec_of(state.params["classifier"]["bias"]) == P(MODEL_AXIS)
+    # Non-head params replicated.
+    assert state.params["Conv_0"]["kernel"].sharding.is_fully_replicated
+    # The optimizer slot mirroring the TP kernel is sharded identically —
+    # replicated momentum would all-gather the sharded gradient every step.
+    slots = [
+        leaf for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state.opt_state)[0]
+        if leaf.ndim == 2 and leaf.shape == kernel.shape]
+    assert slots and all(_spec_of(s) == P(None, MODEL_AXIS) for s in slots)
+
+
+def test_tp_train_matches_dp(tiny_cfg, tiny_ds, mesh8):
+    train_ds, _ = tiny_ds
+    model = create_model("tiny_cnn", 10)
+    step = make_train_step(model)
+    host_batch = _host_batch(train_ds)
+    results = []
+    for mesh in (mesh8, _mesh42()):
+        state = place_state(
+            create_train_state(tiny_cfg, jax.random.key(0), steps_per_epoch=4),
+            mesh)
+        sharder = BatchSharder(mesh)
+        for _ in range(3):
+            state, metrics = step(state, sharder(host_batch))
+        results.append((state, float(metrics["loss"])))
+    (s_dp, l_dp), (s_tp, l_tp) = results
+    assert abs(l_dp - l_tp) < 1e-4
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_dp.params)),
+                    jax.tree.leaves(jax.device_get(s_tp.params))):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+    # The head stays sharded THROUGH the jitted update (donation + GSPMD must
+    # not silently re-replicate it).
+    assert not s_tp.params["classifier"]["kernel"].sharding.is_fully_replicated
+
+
+def test_tp_eval_globally_reduced(tiny_cfg, tiny_ds):
+    train_ds, _ = tiny_ds
+    model = create_model("tiny_cnn", 10)
+    mesh = _mesh42()
+    state = place_state(
+        create_train_state(tiny_cfg, jax.random.key(0), steps_per_epoch=4), mesh)
+    m = make_eval_step(model)(state, BatchSharder(mesh)(_host_batch(train_ds)))
+    assert float(m["examples"]) == 64.0
+
+
+def test_tp_scoring_matches_dp(tiny_ds, mesh8):
+    train_ds, _ = tiny_ds
+    small = train_ds.subset(np.arange(64, dtype=np.int32))
+    model = create_model("tiny_cnn", 10)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 32, 32, 3), np.float32))
+    mesh_tp = _mesh42()
+    for method, kw in (("el2n", {}), ("grand", {"chunk": 2})):
+        s_dp = score_dataset(model, [replicate(variables, mesh8)], small,
+                             method=method, batch_size=32,
+                             sharder=BatchSharder(mesh8), **kw)
+        s_tp = score_dataset(model, [replicate(variables, mesh_tp)], small,
+                             method=method, batch_size=32,
+                             sharder=BatchSharder(mesh_tp), **kw)
+        np.testing.assert_allclose(s_tp, s_dp, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_fit_and_datadiet_end_to_end(tiny_cfg, tiny_ds, tmp_path):
+    """The production entry: cfg.mesh.model_axis=2 through run_datadiet —
+    score (flattened mesh) -> prune -> retrain (TP head) -> eval."""
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    from data_diet_distributed_tpu.train.loop import fit, run_datadiet
+
+    train_ds, test_ds = tiny_ds
+    cfg = tiny_cfg
+    cfg.mesh.data_axis, cfg.mesh.model_axis = 4, 2
+    cfg.train.checkpoint_dir = str(tmp_path / "tp_ckpt")
+    cfg.obs.metrics_path = str(tmp_path / "tp_metrics.jsonl")
+    cfg.prune.sparsity = 0.5
+    cfg.score.method = "el2n"
+
+    mesh = make_mesh(cfg.mesh)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    res = fit(cfg, train_ds, test_ds, mesh=mesh, sharder=BatchSharder(mesh))
+    assert not (res.state.params["classifier"]["kernel"]
+                .sharding.is_fully_replicated)
+    assert np.isfinite(res.history[-1]["train_loss"])
+
+    summary = run_datadiet(cfg, MetricsLogger(None, echo=False))
+    assert summary["n_kept"] == 128
+    assert summary["final_test_accuracy"] is not None
