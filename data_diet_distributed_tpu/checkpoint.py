@@ -84,6 +84,22 @@ class CheckpointManager:
                              opt_state=payload["opt_state"],
                              step=payload["step"])
 
+    def metrics(self, step: int | None = None) -> dict[str, Any] | None:
+        """The metrics JSON saved alongside a step (None if absent) — carries
+        the epoch counter, so resume does not have to derive it from
+        ``steps_per_epoch`` (wrong whenever the resuming run uses a different
+        batch size than the saving run)."""
+        self._mngr.wait_until_finished()
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore()))
+            return restored["metrics"]
+        except KeyError:    # saved without a metrics item — a legitimate None;
+            return None     # real IO/corruption errors propagate
+
     def restore_variables(self, state: "TrainState", step: int | None = None):
         """Params + batch_stats only — what the scoring phase needs (reference loads
         checkpoint key ``"net"`` for scoring, ``train.py:63``)."""

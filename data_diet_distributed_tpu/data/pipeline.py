@@ -78,8 +78,7 @@ class BatchSharder:
         mesh — see ``flat``."""
         self.mesh = mesh
         self.axes = tuple(axes) if axes is not None else (data_axis,)
-        spec = P(self.axes if len(self.axes) > 1 else self.axes[0])
-        self.sharding = NamedSharding(mesh, spec)
+        self.sharding = NamedSharding(mesh, P(self.axes))
         self._shards = int(np.prod([mesh.shape[a] for a in self.axes]))
 
     @classmethod

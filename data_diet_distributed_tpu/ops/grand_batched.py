@@ -175,6 +175,14 @@ def _explicit_padding(padding, x: jax.Array, g: jax.Array, rec: dict):
     return tuple(out)
 
 
+# Prefer the direct-form Pallas kernels over the XLA Gram form as long as the
+# direct FLOPs are within this factor of Gram's: measured on v5e, the fused
+# kernels sustain ~4× the Gram einsum's throughput (no patch/M/pp/gg HBM
+# materialization, full MXU tiles), so paying up to ~8× the FLOPs still wins
+# or ties, and the stage-4 geometries (ratio ≥ 14) correctly stay on Gram.
+_DIRECT_OVER_GRAM_MAX_RATIO = 8.0
+
+
 def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
                   use_pallas: bool = False) -> jax.Array:
     """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
@@ -183,12 +191,24 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
     f = int(np_prod(rec["kernel_size"])) * x.shape[-1]
     k = g.shape[-1]
     gram = s * (f + k) < f * k
-    if use_pallas and not gram:
+    # Kernel-eligible iff direct FLOPs are within the ratio of Gram's (the
+    # not-gram case satisfies this by definition: f*k <= s*(f+k)).
+    direct_ok = f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
+    if use_pallas and direct_ok:
         from .pallas_kernels import (conv_grad_norm_pallas_fits,
-                                     conv_grad_norm_sq_pallas)
+                                     conv_grad_norm_sq_pallas,
+                                     conv_grad_norm_sq_v2,
+                                     conv_grad_norm_v2_eligible)
         pad = _explicit_padding(rec["padding"], x, g, rec)
-        if conv_grad_norm_pallas_fits(x.shape, g.shape, rec["kernel_size"],
+        if conv_grad_norm_v2_eligible(x.shape, g.shape, rec["kernel_size"],
                                       rec["strides"], x.dtype.itemsize):
+            # Raw-x kernel: padding is virtual (VMEM zero borders), the bias
+            # term is fused — no XLA pad, no second read of g.
+            return conv_grad_norm_sq_v2(x, g, tuple(rec["kernel_size"]), pad,
+                                        use_bias=rec["use_bias"])
+        if not gram and conv_grad_norm_pallas_fits(
+                x.shape, g.shape, rec["kernel_size"], rec["strides"],
+                x.dtype.itemsize):
             contrib = conv_grad_norm_sq_pallas(
                 x, g, tuple(rec["kernel_size"]), tuple(rec["strides"]), pad)
             if rec["use_bias"]:
@@ -233,17 +253,27 @@ def _dense_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
 
 
 def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array:
+    """Eval-mode BatchNorm grad-norm² from two channel reductions.
+
+    ``Σ_s g·x̂ = rsqrt(σ²+ε)·(Σ_s g·x − μ·Σ_s g)``, so instead of
+    materializing ``x̂`` and ``g`` in float32 at activation size (profiled as
+    several HBM round trips per BN layer), reduce ``g·x`` and ``g`` straight to
+    per-channel sums — two fused einsums with float32 accumulation — and apply
+    the affine correction on the tiny [B, C] result."""
     stats_scope = reduce(lambda d, k: d[k], rec["path"], batch_stats)
-    mean, var = stats_scope["mean"], stats_scope["var"]
-    xhat = (x.astype(_F32) - mean) * jax.lax.rsqrt(var.astype(_F32)
-                                                   + rec["epsilon"])
+    mean = stats_scope["mean"].astype(_F32)
+    rstd = jax.lax.rsqrt(stats_scope["var"].astype(_F32) + rec["epsilon"])
+    # Plain multiply+reduce (NOT einsum): XLA fuses the upcast/multiply chain
+    # into the reduction's accumulator — an einsum here lowers to a dot with
+    # (b, c) batch dims, whose operand transposes are full HBM round trips.
     axes = tuple(range(1, x.ndim - 1))
-    g32 = g.astype(_F32)
+    gx = jnp.sum(g.astype(_F32) * x.astype(_F32), axis=axes)
+    gs = jnp.sum(g.astype(_F32), axis=axes)
     contrib = 0.0
     if rec["use_scale"]:
-        contrib = contrib + _sq(jnp.sum(g32 * xhat, axis=axes), axis=-1)
+        contrib = contrib + jnp.sum(((gx - mean * gs) * rstd) ** 2, axis=-1)
     if rec["use_bias"]:
-        contrib = contrib + _sq(jnp.sum(g32, axis=axes), axis=-1)
+        contrib = contrib + jnp.sum(gs * gs, axis=-1)
     return contrib
 
 

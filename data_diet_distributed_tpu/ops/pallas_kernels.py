@@ -120,11 +120,15 @@ def _conv_norm_kernel(kh, kw, x_ref, g_ref, out_ref):
 
 
 def _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) -> int:
-    """Largest batch tile whose working set fits the VMEM budget (0 = none)."""
+    """Largest batch tile whose working set fits the VMEM budget (0 = none).
+
+    Tiles below 8 are NOT offered: the output block is ``(tile, 1)`` and
+    Mosaic requires its sublane dim divisible by 8 — a tile of 4 compiles in
+    interpret mode but crashes the hardware lowering."""
     lane = 128
     cpad, kpad = -(-c // lane) * lane, -(-k // lane) * lane
     per_ex = (hp * wp * cpad + ho * wo * kpad) * itemsize + cpad * kpad * 4
-    for tile in (8, 4, 2, 1):
+    for tile in (8,):
         if 2 * tile * per_ex <= _CONV_VMEM_BUDGET:   # ×2: double-buffer margin
             return tile
     return 0
@@ -208,6 +212,138 @@ def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
             x_phase = _grow(x_phase, khp - 1 + ho, kwp - 1 + wo)
             total = total + _unit_stride_norm_sq(x_phase, g, khp, kwp, interpret)
     return total
+
+
+# --------------------------------------------------------------------------
+# v2 conv weight-grad-norm kernel: raw (unpadded) x staged by manual DMA.
+# --------------------------------------------------------------------------
+#
+# The v1 kernel takes pre-padded x, which costs one XLA `pad` (HBM write+read
+# of the whole activation) plus a layout copy per layer — profiled at ~1/3 of
+# the whole scoring pass across 13 conv layers. v2 takes RAW x and g in ANY
+# (HBM) memory space and stages them itself: x rows are DMA'd into a
+# zero-bordered VMEM buffer whose interior sits at an 8-aligned column offset
+# (DMA stores must be sublane-aligned; reads of the shifted offset windows may
+# be unaligned). SAME/explicit padding then costs nothing — the border zeros
+# live only in VMEM, once.
+#
+# Eligibility: unit stride, and channel count a multiple of 128 (slicing a
+# lane-padded HBM memref for the DMA is unsupported by Mosaic) — i.e. the
+# C>=128 stages of the zoo, which are exactly the layers where the per-offset
+# [C, K] contraction fills full MXU tiles. 64-channel and strided layers stay
+# on v1; tiny-F layers (stem) on XLA.
+
+_V2_COL0 = 8           # interior column offset (8-aligned DMA store)
+_V2_VMEM_BUDGET = 12 << 20
+_V2_ROW_TARGET = 256   # output rows per dot chunk ~ contraction depth
+
+
+def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, itemsize: int = 2):
+    """(rows, cols, rc) of the staging buffer if v2 can run this layer, else None."""
+    kh, kw = kernel_size
+    if tuple(strides) != (1, 1):
+        return None
+    b, h, w, c = x_shape
+    ho, wo, k = g_shape[1:]
+    if c % 128 != 0 or k % 128 != 0 or c > 512 or k > 512:
+        return None
+    rows = kh - 1 + ho
+    need = _V2_COL0 + max(w, wo + kw - 1)
+    cols = need + (-need) % 8
+    rc = max(1, min(ho, _V2_ROW_TARGET // wo))
+    tile = 8
+    xbuf = rows * cols * c * itemsize
+    gbuf = ho * wo * (-(-k // 128) * 128) * itemsize
+    macc = c * (-(-k // 128) * 128) * 4
+    temps = 2 * rc * wo * (c + (-(-k // 128) * 128)) * itemsize  # xs/gs reshapes
+    if tile * (xbuf + gbuf + macc + temps) > _V2_VMEM_BUDGET:
+        return None
+    return rows, cols, rc
+
+
+def conv_grad_norm_v2_eligible(x_shape, g_shape, kernel_size, strides,
+                               itemsize: int = 2) -> bool:
+    return _conv_v2_plan(x_shape, g_shape, kernel_size, strides,
+                         itemsize) is not None
+
+
+def _conv_v2_kernel(kh, kw, pt, plft, h, w, rc, use_bias,
+                    x_hbm, g_hbm, out_ref, xbuf, gbuf, macc, sem):
+    i = pl.program_id(0)
+    tile = gbuf.shape[0]
+    ho, wo, k = gbuf.shape[1:]
+    c = xbuf.shape[-1]
+
+    # Zero every step: borders must be zero and interpret mode does not
+    # guarantee scratch persistence across grid steps (on TPU this memset is
+    # ~µs against ~100µs of matmuls).
+    xbuf[...] = jnp.zeros_like(xbuf)
+    dx = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * tile, tile)],
+        xbuf.at[:, pl.ds(pt, h), pl.ds(_V2_COL0, w), :], sem.at[0])
+    dg = pltpu.make_async_copy(g_hbm.at[pl.ds(i * tile, tile)], gbuf, sem.at[1])
+    dx.start()
+    dg.start()
+    dx.wait()
+    dg.wait()
+
+    first = True
+    for oy in range(kh):
+        for ox in range(kw):
+            macc[...] = jnp.zeros_like(macc)
+            for r0 in range(0, ho, rc):
+                nr = min(rc, ho - r0)
+                xs = xbuf[:, oy + r0:oy + r0 + nr,
+                          _V2_COL0 - plft + ox:_V2_COL0 - plft + ox + wo, :]
+                gs = gbuf[:, r0:r0 + nr]
+                macc[...] += jax.lax.dot_general(
+                    xs.reshape(tile, nr * wo, c), gs.reshape(tile, nr * wo, k),
+                    (((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+            m = macc[...]
+            part = jnp.sum(jnp.sum(m * m, axis=2), axis=1, keepdims=True)
+            out_ref[...] = part if first else out_ref[...] + part
+            first = False
+    if use_bias:
+        gsum = jnp.sum(gbuf[...].astype(jnp.float32).reshape(tile, ho * wo, k),
+                       axis=1)
+        out_ref[...] += jnp.sum(gsum * gsum, axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_size", "padding",
+                                             "use_bias", "interpret"))
+def conv_grad_norm_sq_v2(x: jax.Array, g: jax.Array, kernel_size, padding,
+                         use_bias: bool = False,
+                         interpret: bool | None = None) -> jax.Array:
+    """[B] ⟵ ‖per-example conv weight gradient‖²_F (+ bias-grad² when
+    ``use_bias``), unit-stride conv, raw unpadded ``x`` — padding is virtual
+    (zero borders staged in VMEM). See the v2 design note above."""
+    kh, kw = kernel_size
+    (pt, _pb), (plft, _pr) = padding
+    b, h, w, c = x.shape
+    ho, wo, k = g.shape[1:]
+    plan = _conv_v2_plan(x.shape, g.shape, kernel_size, (1, 1), x.dtype.itemsize)
+    assert plan is not None, "caller must check conv_grad_norm_v2_eligible"
+    rows, cols, rc = plan
+    tile = 8
+    (x, g), b_pad = _pad_batch([x, g], b, tile)
+    out = pl.pallas_call(
+        functools.partial(_conv_v2_kernel, kh, kw, pt, plft, h, w, rc, use_bias),
+        grid=(b_pad // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((tile, rows, cols, c), x.dtype),
+            pltpu.VMEM((tile, ho, wo, k), g.dtype),
+            pltpu.VMEM((tile, c, k), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_auto_interpret(interpret),
+    )(x, g)
+    return out[:b, 0]
 
 
 def _gll_kernel(feats_ref, w_ref, b_ref, labels_ref, mask_ref, out_ref):

@@ -71,7 +71,7 @@ def _forward(model, variables, images, *, eval_mode: bool, capture_features=Fals
     return out
 
 
-def _wrap(local_scores, mesh: Mesh | None, data_axis: str = "data"):
+def _wrap(local_scores, mesh: Mesh | None):
     """Lift a per-device ``(variables, image, label, mask) -> scores`` function to a
     jitted whole-batch step, sharded over the FLATTENED mesh (every axis, ``data``
     first) when a multi-device mesh is given: per-example scoring has no
@@ -94,8 +94,8 @@ def _wrap(local_scores, mesh: Mesh | None, data_axis: str = "data"):
                                 batch["mask"])
         return step
 
-    axes = (data_axis, *[a for a in mesh.axis_names if a != data_axis])
-    spec = P(axes if len(axes) > 1 else axes[0])
+    from ..parallel.mesh import flat_batch_spec
+    spec = flat_batch_spec(mesh)
     sharded = jax.shard_map(
         local_scores, mesh=mesh,
         in_specs=(P(), spec, spec, spec),
@@ -151,7 +151,7 @@ def make_grand_last_layer_step(model, mesh: Mesh | None = None,
 
 @functools.cache
 def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
-                    data_axis: str = "data", eval_mode: bool = True,
+                    eval_mode: bool = True,
                     use_pallas: bool | None = None):
     """Full GraNd: per-example gradient norm over ALL parameters.
 
@@ -187,12 +187,11 @@ def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
             (imgs, labs))
         return norms.reshape(-1)[:n] * mask
 
-    return _wrap(local_scores, mesh, data_axis)
+    return _wrap(local_scores, mesh)
 
 
 @functools.cache
 def make_grand_batched_step(model, mesh: Mesh | None = None,
-                            data_axis: str = "data",
                             use_pallas: bool | None = None):
     """Full GraNd via the batched exact algorithm (``grand_batched.py``): one
     batched forward + one backward w.r.t. per-layer output perturbations, then
@@ -208,7 +207,7 @@ def make_grand_batched_step(model, mesh: Mesh | None = None,
         return batched_grand_scores(model, variables, image, label, mask,
                                     use_pallas=use_pallas)
 
-    return _wrap(local_scores, mesh, data_axis)
+    return _wrap(local_scores, mesh)
 
 
 @functools.cache

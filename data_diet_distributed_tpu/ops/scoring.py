@@ -58,6 +58,12 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         # every axis — ops/scores._wrap): re-sharder so host placement matches
         # the step's layout and batch sizes round to all-device divisibility.
         sharder = BatchSharder.flat(mesh)
+    if mesh is not None and mesh.size > 1:
+        # Re-replicate TP-sharded scoring params ONCE: the score step's
+        # shard_map takes variables at P(), and leaving the resharding to jit
+        # would all-gather the classifier on EVERY batch invocation.
+        from ..parallel.mesh import replicate
+        variables_seeds = [replicate(v, mesh) for v in variables_seeds]
     if score_step is None:
         score_step = make_score_step(model, method, mesh, chunk=chunk,
                                      eval_mode=eval_mode, use_pallas=use_pallas)

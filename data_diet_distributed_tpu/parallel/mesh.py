@@ -56,6 +56,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def flat_batch_spec(mesh: Mesh) -> P:
+    """Batch-dim spec over EVERY mesh axis, in mesh order (data first — all
+    meshes here come from ``make_mesh``). The scoring layout: per-example work
+    has nothing for a ``model`` axis to do, so all devices score distinct
+    examples. One definition so host placement (``BatchSharder.flat``) and the
+    score step's shard_map specs (``ops/scores._wrap``) can never diverge."""
+    return P(tuple(mesh.axis_names))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 

@@ -148,7 +148,24 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         if cfg.train.resume and (resume_step is not None
                                  or ckpt.latest_step() is not None):
             state = ckpt.restore(state, resume_step)
-            start_epoch = int(state.step) // steps_per_epoch
+            # The epoch comes from checkpoint metadata, NOT step//steps_per_epoch:
+            # the saving run may have used a different batch size (different
+            # steps_per_epoch), which would silently land on the wrong epoch.
+            meta = ckpt.metrics(resume_step)
+            if meta is not None and "epoch" in meta:
+                start_epoch = int(meta["epoch"]) + 1
+                saved_spe = meta.get("steps_per_epoch")
+                if saved_spe is not None and int(saved_spe) != steps_per_epoch:
+                    raise ValueError(
+                        f"resume: this run has steps_per_epoch="
+                        f"{steps_per_epoch} but the checkpoint was saved with "
+                        f"{saved_spe} (different batch size or dataset). The "
+                        "cosine LR schedule is step-indexed, so continuing "
+                        "would silently change the learning-rate trajectory — "
+                        "resume with the saving run's data.batch_size, or "
+                        "train fresh with resume=false")
+            else:
+                start_epoch = int(state.step) // steps_per_epoch
             logger.log("resume", tag=tag, step=int(state.step), epoch=start_epoch)
 
     train_step = make_train_step(model)
@@ -229,8 +246,11 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         result.history.append(record)
         if ckpt is not None and ((epoch + 1) % cfg.train.checkpoint_every == 0
                                  or epoch + 1 == cfg.train.num_epochs):
-            ckpt.save(int(state.step), state, metrics={"epoch": epoch, **{
-                k: v for k, v in record.items() if isinstance(v, (int, float))}})
+            ckpt.save(int(state.step), state, metrics={
+                "epoch": epoch,
+                "steps_per_epoch": num_batches(len(train_ds), batch_size),
+                **{k: v for k, v in record.items()
+                   if isinstance(v, (int, float))}})
             if saved_steps is not None:
                 saved_steps.append(int(state.step))
         result.state = state

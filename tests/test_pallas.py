@@ -94,6 +94,45 @@ class TestConvGradNorm:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-4)
 
+    @pytest.mark.parametrize("h,c,k,bias", [
+        (16, 128, 128, False),   # stage-2 geometry (v2's main target)
+        (8, 256, 256, True),     # stage-3 geometry + fused bias term
+        (8, 128, 256, False),    # channel-doubling stage entry (unit stride)
+    ])
+    def test_v2_matches_xla(self, h, c, k, bias):
+        """Raw-x DMA kernel (virtual padding, fused bias) vs the patch-einsum
+        reference on the 128-multiple-channel geometries it accepts."""
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_grad_norm_sq_v2, conv_grad_norm_v2_eligible)
+        ks, st, pad = (3, 3), (1, 1), ((1, 1), (1, 1))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(10, h, h, c)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(10, h, h, k)).astype(np.float32))
+        assert conv_grad_norm_v2_eligible(x.shape, g.shape, ks, st,
+                                          x.dtype.itemsize)
+        got = conv_grad_norm_sq_v2(x, g, ks, pad, use_bias=bias, interpret=True)
+        ref = self._ref(x, g, ks, st, pad)
+        if bias:
+            gsum = jnp.sum(g.reshape(10, -1, k), axis=1)
+            ref = ref + jnp.sum(gsum * gsum, axis=-1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-3)
+
+    def test_v2_eligibility_gates(self):
+        """v2 refuses strided convs and non-128-multiple channels (the HBM DMA
+        cannot slice lane-padded memrefs); v1/XLA handle those."""
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_grad_norm_v2_eligible)
+        ok = conv_grad_norm_v2_eligible((8, 16, 16, 128), (8, 16, 16, 128),
+                                        (3, 3), (1, 1), 2)
+        assert ok
+        assert not conv_grad_norm_v2_eligible(
+            (8, 16, 16, 128), (8, 8, 8, 128), (3, 3), (2, 2), 2)   # strided
+        assert not conv_grad_norm_v2_eligible(
+            (8, 16, 16, 64), (8, 16, 16, 128), (3, 3), (1, 1), 2)  # c % 128
+        assert not conv_grad_norm_v2_eligible(
+            (8, 16, 16, 128), (8, 16, 16, 64), (3, 3), (1, 1), 2)  # k % 128
+
     def test_batched_grand_with_pallas_matches_vmap(self):
         """End-to-end: batched GraNd with the fused conv kernel (interpret mode)
         equals vmap(grad) ground truth."""
