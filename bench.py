@@ -36,6 +36,13 @@ def main() -> None:
     parser.add_argument("--method", default="grand",
                         choices=["grand", "grand_vmap", "el2n", "grand_last_layer"])
     parser.add_argument("--arch", default="resnet18")
+    parser.add_argument("--dataset", default="synthetic",
+                        choices=["synthetic", "synthetic_imagenet"],
+                        help="synthetic = CIFAR geometry (32x32/10); "
+                             "synthetic_imagenet = 96x96/100 (BASELINE cfg 5)")
+    parser.add_argument("--stem", default=None, choices=["cifar", "imagenet"],
+                        help="ResNet stem (default: imagenet for "
+                             "synthetic_imagenet, cifar otherwise)")
     parser.add_argument("--chunk", type=int, default=64,
                         help="vmap(grad) chunk per device for full GraNd")
     parser.add_argument("--repeats", type=int, default=3)
@@ -57,8 +64,11 @@ def main() -> None:
     sharder = BatchSharder(mesh)
     batch_size = sharder.global_batch_size_for(args.batch)
 
-    train_ds, _ = load_dataset("synthetic", synthetic_size=args.size, seed=0)
-    model = create_model(args.arch, 10, half_precision=True)
+    train_ds, _ = load_dataset(args.dataset, synthetic_size=args.size, seed=0)
+    stem = args.stem or ("imagenet" if args.dataset == "synthetic_imagenet"
+                         else "cifar")
+    model = create_model(args.arch, train_ds.num_classes, half_precision=True,
+                         stem=stem)
     variables = jax.jit(model.init, static_argnames=("train",))(
         jax.random.key(0),
         np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
@@ -114,13 +124,16 @@ def bench_train(args) -> None:
     from data_diet_distributed_tpu.train.loop import fit
 
     repeats = max(1, args.repeats)   # epoch 0 is warmup; need >=1 steady epoch
+    stem = args.stem or ("imagenet" if args.dataset == "synthetic_imagenet"
+                         else "cifar")
     cfg = load_config(None, [
-        "data.dataset=synthetic", f"data.synthetic_size={args.size}",
+        f"data.dataset={args.dataset}", f"data.synthetic_size={args.size}",
         f"data.batch_size={args.batch}", f"model.arch={args.arch}",
+        f"model.stem={stem}",
         f"train.num_epochs={repeats + 1}", "train.half_precision=true",
         "train.log_every_steps=100000"])
     mesh = make_mesh(cfg.mesh)
-    train_ds, _ = load_dataset("synthetic", synthetic_size=args.size, seed=0)
+    train_ds, _ = load_dataset(args.dataset, synthetic_size=args.size, seed=0)
     res = fit(cfg, train_ds, None, mesh=mesh, sharder=BatchSharder(mesh))
     # Epoch 0 pays upload + compile; report the steady-state epochs.
     steady = res.history[1:]

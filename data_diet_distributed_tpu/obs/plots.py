@@ -74,6 +74,23 @@ def plot_utilization(monitor_path: str, out_dir: str = "./plots",
     plt.close(fig)
     written.append(path)
 
+    # Device duty cycle (probe-latency busy fraction — obs/monitor._DutyProbe),
+    # the TPU stand-in for the reference's GPU utilization % (ddp_new.py:37-39).
+    duty = [(t, r["duty_cycle"]) for t, r in zip(times, records)
+            if isinstance(r.get("duty_cycle"), (int, float))]
+    if duty:
+        fig, ax = plt.subplots(figsize=(8, 3))
+        ax.plot([p[0] for p in duty], [100.0 * p[1] for p in duty], lw=1.0)
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("device busy %")
+        ax.set_ylim(0, 105)
+        ax.set_title("Device duty cycle (probe estimate)")
+        fig.tight_layout()
+        path = os.path.join(out_dir, "device_duty_cycle.png")
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        written.append(path)
+
     # One HBM trace per device; devices discovered from the samples themselves.
     # One unit for the whole axis: percent only when EVERY sample carries a limit,
     # GiB otherwise (mixing per-point units would render a quantitatively wrong

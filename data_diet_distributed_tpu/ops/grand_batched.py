@@ -201,7 +201,7 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
                                      conv_grad_norm_v2_eligible)
         pad = _explicit_padding(rec["padding"], x, g, rec)
         if conv_grad_norm_v2_eligible(x.shape, g.shape, rec["kernel_size"],
-                                      rec["strides"], x.dtype.itemsize):
+                                      rec["strides"], pad, x.dtype.itemsize):
             # Raw-x kernel: padding is virtual (VMEM zero borders), the bias
             # term is fused — no XLA pad, no second read of g.
             return conv_grad_norm_sq_v2(x, g, tuple(rec["kernel_size"]), pad,

@@ -238,7 +238,8 @@ _V2_VMEM_BUDGET = 12 << 20
 _V2_ROW_TARGET = 256   # output rows per dot chunk ~ contraction depth
 
 
-def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, itemsize: int = 2):
+def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
+                  itemsize: int = 2):
     """(rows, cols, rc) of the staging buffer if v2 can run this layer, else None."""
     kh, kw = kernel_size
     if tuple(strides) != (1, 1):
@@ -246,6 +247,12 @@ def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, itemsize: int = 2):
     b, h, w, c = x_shape
     ho, wo, k = g_shape[1:]
     if c % 128 != 0 or k % 128 != 0 or c > 512 or k > 512:
+        return None
+    # DMA slices on the sublane (W) dim must be 8-aligned in start AND extent;
+    # the interior sits at column _V2_COL0, so left padding must fit before it.
+    if w % 8 != 0 or wo % 8 != 0:
+        return None
+    if padding is not None and padding[1][0] > _V2_COL0:
         return None
     rows = kh - 1 + ho
     need = _V2_COL0 + max(w, wo + kw - 1)
@@ -262,8 +269,8 @@ def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, itemsize: int = 2):
 
 
 def conv_grad_norm_v2_eligible(x_shape, g_shape, kernel_size, strides,
-                               itemsize: int = 2) -> bool:
-    return _conv_v2_plan(x_shape, g_shape, kernel_size, strides,
+                               padding=None, itemsize: int = 2) -> bool:
+    return _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
                          itemsize) is not None
 
 
@@ -322,7 +329,8 @@ def conv_grad_norm_sq_v2(x: jax.Array, g: jax.Array, kernel_size, padding,
     (pt, _pb), (plft, _pr) = padding
     b, h, w, c = x.shape
     ho, wo, k = g.shape[1:]
-    plan = _conv_v2_plan(x.shape, g.shape, kernel_size, (1, 1), x.dtype.itemsize)
+    plan = _conv_v2_plan(x.shape, g.shape, kernel_size, (1, 1), padding,
+                         x.dtype.itemsize)
     assert plan is not None, "caller must check conv_grad_norm_v2_eligible"
     rows, cols, rc = plan
     tile = 8

@@ -142,54 +142,63 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
     ckpt = None
     start_epoch = 0
-    if checkpoint_dir:
-        ckpt = CheckpointManager(checkpoint_dir,
-                                 max_to_keep=cfg.train.keep_checkpoints)
-        if cfg.train.resume and (resume_step is not None
-                                 or ckpt.latest_step() is not None):
-            state = ckpt.restore(state, resume_step)
-            # The epoch comes from checkpoint metadata, NOT step//steps_per_epoch:
-            # the saving run may have used a different batch size (different
-            # steps_per_epoch), which would silently land on the wrong epoch.
-            meta = ckpt.metrics(resume_step)
-            if meta is not None and "epoch" in meta:
-                start_epoch = int(meta["epoch"]) + 1
-                saved_spe = meta.get("steps_per_epoch")
-                if saved_spe is not None and int(saved_spe) != steps_per_epoch:
-                    raise ValueError(
-                        f"resume: this run has steps_per_epoch="
-                        f"{steps_per_epoch} but the checkpoint was saved with "
-                        f"{saved_spe} (different batch size or dataset). The "
-                        "cosine LR schedule is step-indexed, so continuing "
-                        "would silently change the learning-rate trajectory — "
-                        "resume with the saving run's data.batch_size, or "
-                        "train fresh with resume=false")
-            else:
-                start_epoch = int(state.step) // steps_per_epoch
-            logger.log("resume", tag=tag, step=int(state.step), epoch=start_epoch)
-
-    train_step = make_train_step(model)
-    eval_step = make_eval_step(model) if test_ds is not None else None
-
-    # Device-resident epoch data: upload the (pruned) train set — and the test
-    # set, re-streamed every eval otherwise — to HBM once, in the model's compute
-    # dtype. Per-epoch host→device traffic becomes just the index permutation.
-    # A caller-provided ``train_resident`` (multi-seed scoring pretrains share
-    # one upload across seeds) is used as-is.
-    if train_resident is None:
-        train_resident = _train_resident(cfg, train_ds, mesh, sharder)
-    test_resident = None
-    if test_ds is not None:
-        test_resident = maybe_resident(
-            test_ds, mesh, sharder.global_batch_size_for(cfg.data.eval_batch_size),
-            _image_dtype(cfg), enabled=cfg.train.device_resident_data)
+    try:
+        if checkpoint_dir:
+            ckpt = CheckpointManager(checkpoint_dir,
+                                     max_to_keep=cfg.train.keep_checkpoints)
+            if cfg.train.resume and (resume_step is not None
+                                     or ckpt.latest_step() is not None):
+                state = ckpt.restore(state, resume_step)
+                # The epoch comes from checkpoint metadata, NOT
+                # step//steps_per_epoch: the saving run may have used a
+                # different batch size (different steps_per_epoch), which
+                # would silently land on the wrong epoch.
+                meta = ckpt.metrics(resume_step)
+                if meta is not None and "epoch" in meta:
+                    start_epoch = int(meta["epoch"]) + 1
+                    saved_spe = meta.get("steps_per_epoch")
+                    if saved_spe is not None and int(saved_spe) != steps_per_epoch:
+                        raise ValueError(
+                            f"resume: this run has steps_per_epoch="
+                            f"{steps_per_epoch} but the checkpoint was saved "
+                            f"with {saved_spe} (different batch size or "
+                            "dataset). The cosine LR schedule is step-indexed, "
+                            "so continuing would silently change the "
+                            "learning-rate trajectory — resume with the saving "
+                            "run's data.batch_size, or train fresh with "
+                            "resume=false")
+                else:
+                    start_epoch = int(state.step) // steps_per_epoch
+                logger.log("resume", tag=tag, step=int(state.step),
+                           epoch=start_epoch)
+    except Exception:
+        if ckpt is not None:   # refuse-to-resume must not leak the async manager
+            ckpt.close()
+        raise
 
     result = FitResult(state=state)
     t_start = time.perf_counter()
     try:
+        train_step = make_train_step(model)
+        eval_step = make_eval_step(model) if test_ds is not None else None
+
+        # Device-resident epoch data: upload the (pruned) train set — and the
+        # test set, re-streamed every eval otherwise — to HBM once, in the
+        # model's compute dtype. Per-epoch host→device traffic becomes just the
+        # index permutation. A caller-provided ``train_resident`` (multi-seed
+        # scoring pretrains share one upload across seeds) is used as-is.
+        if train_resident is None:
+            train_resident = _train_resident(cfg, train_ds, mesh, sharder)
+        test_resident = None
+        if test_ds is not None:
+            test_resident = maybe_resident(
+                test_ds, mesh,
+                sharder.global_batch_size_for(cfg.data.eval_batch_size),
+                _image_dtype(cfg), enabled=cfg.train.device_resident_data)
+
         _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                     sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                    saved_steps, train_resident, test_resident)
+                    saved_steps, train_resident, test_resident, steps_per_epoch)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -199,7 +208,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                saved_steps=None, train_resident=None, test_resident=None):
+                saved_steps=None, train_resident=None, test_resident=None,
+                steps_per_epoch=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         shuffle = cfg.data.shuffle_each_epoch
@@ -248,7 +258,9 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                                  or epoch + 1 == cfg.train.num_epochs):
             ckpt.save(int(state.step), state, metrics={
                 "epoch": epoch,
-                "steps_per_epoch": num_batches(len(train_ds), batch_size),
+                # fit's value, not recomputed: the resume-time mismatch check
+                # must compare the same quantity the saver recorded.
+                "steps_per_epoch": steps_per_epoch,
                 **{k: v for k, v in record.items()
                    if isinstance(v, (int, float))}})
             if saved_steps is not None:

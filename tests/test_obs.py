@@ -28,13 +28,36 @@ def _mpl_available():
 
 def test_monitor_writes_samples(tmp_path):
     path = str(tmp_path / "util.jsonl")
-    with ResourceMonitor(path, interval_s=0.05):
+    with ResourceMonitor(path, interval_s=0.05, probe_duty=False):
         time.sleep(0.3)
     lines = [l for l in open(path).read().splitlines() if l]
     assert len(lines) >= 2
     rec = json.loads(lines[0])
     assert 0.0 <= rec["cpu_pct"] <= 100.0
     assert isinstance(rec["devices"], list)
+
+
+def test_monitor_duty_cycle_probe(tmp_path):
+    """Duty-cycle probes report a busy fraction in [0, 1] (the TPU stand-in
+    for the reference's GPU-utilization sampling, ddp_new.py:37-39) and read
+    ~1.0 while the device chews a long dispatch queue."""
+    import jax
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "util.jsonl")
+    with ResourceMonitor(path, interval_s=0.05):
+        # Saturate the default device's stream so probes queue behind work.
+        x = jnp.ones((500, 500))
+        f = jax.jit(lambda x: x @ x + 1.0)
+        t_end = time.time() + 0.5
+        while time.time() < t_end:
+            x = f(x)
+        jax.block_until_ready(x)
+    recs = [json.loads(l) for l in open(path).read().splitlines() if l]
+    duties = [r["duty_cycle"] for r in recs if "duty_cycle" in r]
+    assert duties, "duty probes produced no samples"
+    assert all(0.0 <= d <= 1.0 for d in duties)
+    assert "probe_ms" in recs[0] and "probe_base_ms" in recs[0]
 
 
 @requires_mpl
@@ -45,12 +68,13 @@ def test_plot_utilization_and_malformed_lines(tmp_path):
         for i in range(5):
             fh.write(json.dumps({
                 "ts": 1000.0 + i, "cpu_pct": 10.0 * i,
+                "duty_cycle": 0.25 * (i % 4),
                 "devices": [{"device": "cpu:0", "bytes_in_use": 2**20 * i,
                              "bytes_limit": 2**30}],
             }) + "\n")
         fh.write('{"truncated": ')  # crashed-run tail
     out = plot_utilization(path, str(tmp_path / "plots"))
-    assert len(out) == 2
+    assert len(out) == 3   # cpu, duty cycle, device memory
     for p in out:
         assert os.path.getsize(p) > 0
 

@@ -108,7 +108,7 @@ class TestConvGradNorm:
         rng = np.random.default_rng(0)
         x = jnp.asarray(rng.normal(size=(10, h, h, c)).astype(np.float32))
         g = jnp.asarray(rng.normal(size=(10, h, h, k)).astype(np.float32))
-        assert conv_grad_norm_v2_eligible(x.shape, g.shape, ks, st,
+        assert conv_grad_norm_v2_eligible(x.shape, g.shape, ks, st, pad,
                                           x.dtype.itemsize)
         got = conv_grad_norm_sq_v2(x, g, ks, pad, use_bias=bias, interpret=True)
         ref = self._ref(x, g, ks, st, pad)
@@ -123,15 +123,21 @@ class TestConvGradNorm:
         cannot slice lane-padded memrefs); v1/XLA handle those."""
         from data_diet_distributed_tpu.ops.pallas_kernels import (
             conv_grad_norm_v2_eligible)
+        pad = ((1, 1), (1, 1))
         ok = conv_grad_norm_v2_eligible((8, 16, 16, 128), (8, 16, 16, 128),
-                                        (3, 3), (1, 1), 2)
+                                        (3, 3), (1, 1), pad, 2)
         assert ok
         assert not conv_grad_norm_v2_eligible(
-            (8, 16, 16, 128), (8, 8, 8, 128), (3, 3), (2, 2), 2)   # strided
+            (8, 16, 16, 128), (8, 8, 8, 128), (3, 3), (2, 2), pad, 2)  # strided
         assert not conv_grad_norm_v2_eligible(
-            (8, 16, 16, 64), (8, 16, 16, 128), (3, 3), (1, 1), 2)  # c % 128
+            (8, 16, 16, 64), (8, 16, 16, 128), (3, 3), (1, 1), pad, 2)  # c%128
         assert not conv_grad_norm_v2_eligible(
-            (8, 16, 16, 128), (8, 16, 16, 64), (3, 3), (1, 1), 2)  # k % 128
+            (8, 16, 16, 128), (8, 16, 16, 64), (3, 3), (1, 1), pad, 2)  # k%128
+        assert not conv_grad_norm_v2_eligible(
+            (8, 12, 12, 256), (8, 12, 12, 256), (3, 3), (1, 1), pad, 2)  # w%8
+        assert not conv_grad_norm_v2_eligible(
+            (8, 16, 16, 128), (8, 16, 16, 128), (19, 19), (1, 1),
+            ((9, 9), (9, 9)), 2)                       # left pad > interior col
 
     def test_batched_grand_with_pallas_matches_vmap(self):
         """End-to-end: batched GraNd with the fused conv kernel (interpret mode)
