@@ -30,9 +30,9 @@ def main() -> None:
                         help="score = GraNd/EL2N scoring throughput (the "
                              "headline metric); train = epoch training "
                              "throughput with device-resident data")
-    parser.add_argument("--size", type=int, default=4096,
+    parser.add_argument("--size", type=int, default=8192,
                         help="examples in the scoring pass")
-    parser.add_argument("--batch", type=int, default=1024)
+    parser.add_argument("--batch", type=int, default=2048)
     parser.add_argument("--method", default="grand",
                         choices=["grand", "grand_vmap", "el2n", "grand_last_layer"])
     parser.add_argument("--arch", default="resnet18")

@@ -121,9 +121,16 @@ class ResourceMonitor:
         with open(self.path, "a", buffering=1) as fh:
             while not self._stop.is_set():
                 # The duty probes ARE the wait when enabled (they sleep through
-                # the interval between probes); otherwise plain wait.
-                duty = (probe.sample(self.interval_s) if probe is not None
-                        else None)
+                # the interval between probes); otherwise plain wait. A probe
+                # failure (backend teardown racing this daemon thread, runtime
+                # hiccup) must not kill CPU/HBM sampling: disable probing and
+                # carry on.
+                duty = None
+                if probe is not None:
+                    try:
+                        duty = probe.sample(self.interval_s)
+                    except Exception:
+                        probe = None
                 if probe is None and self._stop.wait(self.interval_s):
                     break
                 total, idle = _cpu_times()

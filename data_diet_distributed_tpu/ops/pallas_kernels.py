@@ -252,7 +252,7 @@ def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
     # the interior sits at column _V2_COL0, so left padding must fit before it.
     if w % 8 != 0 or wo % 8 != 0:
         return None
-    if padding is not None and padding[1][0] > _V2_COL0:
+    if padding[1][0] > _V2_COL0:
         return None
     rows = kh - 1 + ho
     need = _V2_COL0 + max(w, wo + kw - 1)
@@ -269,7 +269,10 @@ def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
 
 
 def conv_grad_norm_v2_eligible(x_shape, g_shape, kernel_size, strides,
-                               padding=None, itemsize: int = 2) -> bool:
+                               padding, itemsize: int = 2) -> bool:
+    """``padding`` is the explicit ((top, bottom), (left, right)) pairs — it
+    participates in eligibility (left pad must fit before the interior
+    column), so it is required, not defaulted."""
     return _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
                          itemsize) is not None
 

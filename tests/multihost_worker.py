@@ -26,6 +26,7 @@ import sys
 def main() -> None:
     pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
     coordinator, out_dir = sys.argv[3], sys.argv[4]
+    model_axis = int(sys.argv[5]) if len(sys.argv) > 5 else 1
 
     # sys.path[0] is tests/; the package lives at the repo root.
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -54,10 +55,14 @@ def main() -> None:
     assert len(jax.devices()) == 4 * nprocs
     assert is_primary() == (pid == 0)
 
-    mesh = make_mesh(None)
+    # model_axis > 1: multi-process TENSOR parallelism on top of DP — the
+    # classifier shards over 'model' while the batch shards over 'data', both
+    # spanning the 2-process runtime (mesh 4x2 over 8 devices).
+    mesh = make_mesh(MeshConfig(model_axis=model_axis))
     sharder = BatchSharder(mesh)
     results = {"pid": pid, "process_count": jax.process_count(),
-               "n_devices": len(jax.devices())}
+               "n_devices": len(jax.devices()),
+               "mesh": dict(mesh.shape)}
 
     # Divisibility guard: a global batch that does not divide over processes
     # must refuse loudly, not mis-shard.

@@ -29,15 +29,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.fixture(scope="module")
-def multihost_results(tmp_path_factory):
-    out_dir = tmp_path_factory.mktemp("multihost")
+def _launch_pair(out_dir, model_axis: int) -> list[dict]:
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", coordinator, str(out_dir)],
+            [sys.executable, worker, str(pid), "2", coordinator, str(out_dir),
+             str(model_axis)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
         for pid in range(2)
     ]
@@ -54,17 +53,48 @@ def multihost_results(tmp_path_factory):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     results = []
     for pid in range(2):
-        with open(out_dir / f"result_{pid}.json") as fh:
+        with open(os.path.join(str(out_dir), f"result_{pid}.json")) as fh:
             results.append(json.load(fh))
     return results
+
+
+@pytest.fixture(scope="module")
+def multihost_results(tmp_path_factory):
+    return _launch_pair(tmp_path_factory.mktemp("multihost_dp"), 1)
+
+
+@pytest.fixture(scope="module")
+def multihost_tp_results(tmp_path_factory):
+    """2 processes x 4 devices on a {data:4, model:2} mesh: tensor parallelism
+    layered on multi-process data parallelism."""
+    return _launch_pair(tmp_path_factory.mktemp("multihost_tp"), 2)
 
 
 def test_both_processes_joined_the_runtime(multihost_results):
     for r in multihost_results:
         assert r["process_count"] == 2
         assert r["n_devices"] == 8
+        assert r["mesh"] == {"data": 8, "model": 1}
         assert r["guard_raised"] is True
         assert r["rounded_60"] == 64   # lcm(data=8, nprocs=2) = 8 -> round up
+
+
+def test_multihost_tensor_parallel_matches_dp(multihost_results,
+                                              multihost_tp_results):
+    """The {data:4, model:2} two-process run (classifier sharded over 'model'
+    ACROSS the distributed runtime, scoring over the flattened mesh) computes
+    the same numbers as the {data:8} two-process run."""
+    for r in multihost_tp_results:
+        assert r["mesh"] == {"data": 4, "model": 2}
+        assert r["rounded_60"] == 60   # lcm(data=4, nprocs=2) = 4 divides 60
+    dp, tp = multihost_results[0], multihost_tp_results[0]
+    assert tp["train_loss"] == pytest.approx(dp["train_loss"], rel=1e-4)
+    assert tp["train_accuracy"] == pytest.approx(dp["train_accuracy"], abs=1e-6)
+    assert tp["test_accuracy"] == pytest.approx(dp["test_accuracy"], abs=1e-9)
+    assert tp["scores_head"] == pytest.approx(dp["scores_head"], rel=1e-5)
+    r0, r1 = multihost_tp_results
+    assert r0["scores_sum"] == pytest.approx(r1["scores_sum"], rel=1e-6)
+    assert r0["final_step"] == r1["final_step"] == r0["restored_step"]
 
 
 def test_processes_agree(multihost_results):

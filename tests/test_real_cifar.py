@@ -89,11 +89,12 @@ def test_scores_match_torch_oracle_on_real_data(real_run):
     th = torch_el2n(tmodel, torch.tensor(x.transpose(0, 3, 1, 2)),
                     torch.tensor(y))
     rho = spearman(scores[:n], th)
-    assert rho >= 0.98, rho
-
-    np.savez(os.path.join(str(tmp), "real_cifar_scores.npz"),
+    # Artifact FIRST (next to the data, where README says it lives — and so a
+    # near-miss rho still leaves the evidence on disk), assertion after.
+    np.savez(os.path.join(_DATA_DIR, "real_cifar_scores.npz"),
              scores=scores, indices=sub.indices, rho=rho,
              accuracy=res.final_test_accuracy)
+    assert rho >= 0.98, rho
 
 
 def test_score_distribution_is_realistic(real_run):
