@@ -194,18 +194,28 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
     # Kernel-eligible iff direct FLOPs are within the ratio of Gram's (the
     # not-gram case satisfies this by definition: f*k <= s*(f+k)).
     direct_ok = f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
-    if use_pallas and direct_ok:
-        from .pallas_kernels import (conv_grad_norm_pallas_fits,
+    if use_pallas:
+        from .pallas_kernels import (conv_grad_norm_gram_eligible,
+                                     conv_grad_norm_pallas_fits,
+                                     conv_grad_norm_sq_gram,
                                      conv_grad_norm_sq_pallas,
                                      conv_grad_norm_sq_v2,
                                      conv_grad_norm_v2_eligible)
         pad = _explicit_padding(rec["padding"], x, g, rec)
-        if conv_grad_norm_v2_eligible(x.shape, g.shape, rec["kernel_size"],
-                                      rec["strides"], pad, x.dtype.itemsize):
+        if direct_ok and conv_grad_norm_v2_eligible(
+                x.shape, g.shape, rec["kernel_size"], rec["strides"], pad,
+                x.dtype.itemsize):
             # Raw-x kernel: padding is virtual (VMEM zero borders), the bias
             # term is fused — no XLA pad, no second read of g.
             return conv_grad_norm_sq_v2(x, g, tuple(rec["kernel_size"]), pad,
                                         use_bias=rec["use_bias"])
+        if gram and conv_grad_norm_gram_eligible(
+                x.shape, g.shape, rec["kernel_size"], rec["strides"], pad,
+                x.dtype.itemsize):
+            # Fused Gram form: small-S wide-channel layers (stage 4), patches
+            # built in VMEM, grams never touch HBM.
+            return conv_grad_norm_sq_gram(x, g, tuple(rec["kernel_size"]), pad,
+                                          use_bias=rec["use_bias"])
         if not gram and conv_grad_norm_pallas_fits(
                 x.shape, g.shape, rec["kernel_size"], rec["strides"],
                 x.dtype.itemsize):

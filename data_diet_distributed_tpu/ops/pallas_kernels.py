@@ -238,34 +238,77 @@ _V2_VMEM_BUDGET = 12 << 20
 _V2_ROW_TARGET = 256   # output rows per dot chunk ~ contraction depth
 
 
-def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
-                  itemsize: int = 2):
-    """(rows, cols, rc) of the staging buffer if v2 can run this layer, else None."""
+def _stage_geometry(x_shape, g_shape, kernel_size, strides, padding):
+    """Shared staging geometry for the raw-x DMA kernels (v2 direct + Gram).
+
+    Returns ``(rows, cols, w8, wo8)`` or None. Gates common to both kernels:
+    unit stride; channels multiples of 128 (slicing a lane-padded HBM memref
+    for DMA is unsupported); left padding ≤ the interior column offset. Widths
+    are normalized to the 8-sublane DMA granule — the wrappers zero-pad narrow
+    maps (extra g columns contribute nothing; extra x columns sit exactly where
+    the virtual SAME padding is zero)."""
     kh, kw = kernel_size
     if tuple(strides) != (1, 1):
         return None
-    b, h, w, c = x_shape
+    _b, _h, w, c = x_shape
     ho, wo, k = g_shape[1:]
-    if c % 128 != 0 or k % 128 != 0 or c > 512 or k > 512:
-        return None
-    # DMA slices on the sublane (W) dim must be 8-aligned in start AND extent;
-    # the interior sits at column _V2_COL0, so left padding must fit before it.
-    if w % 8 != 0 or wo % 8 != 0:
+    if c % 128 != 0 or k % 128 != 0:
         return None
     if padding[1][0] > _V2_COL0:
         return None
+    w8 = w + (-w) % 8
+    wo8 = wo + (-wo) % 8
     rows = kh - 1 + ho
-    need = _V2_COL0 + max(w, wo + kw - 1)
+    need = _V2_COL0 + max(w8, wo8 + kw - 1)
     cols = need + (-need) % 8
-    rc = max(1, min(ho, _V2_ROW_TARGET // wo))
+    return rows, cols, w8, wo8
+
+
+def _normalize_widths(x, g, w8, wo8):
+    """Zero-pad the W dims up to the planned 8-aligned widths (see above)."""
+    if g.shape[2] != wo8:
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, wo8 - g.shape[2]), (0, 0)))
+    if x.shape[2] != w8:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, w8 - x.shape[2]), (0, 0)))
+    return x, g
+
+
+def _stage_dma(x_hbm, g_hbm, xbuf, gbuf, sem, i, tile, pt, h, w):
+    """Kernel-side preamble shared by the DMA kernels: zero the bordered x
+    buffer (virtual padding; zeroed every step — interpret mode does not
+    guarantee scratch persistence, and on TPU this memset is ~µs against
+    ~100µs of matmuls) and stage x rows + g."""
+    xbuf[...] = jnp.zeros_like(xbuf)
+    dx = pltpu.make_async_copy(
+        x_hbm.at[pl.ds(i * tile, tile)],
+        xbuf.at[:, pl.ds(pt, h), pl.ds(_V2_COL0, w), :], sem.at[0])
+    dg = pltpu.make_async_copy(g_hbm.at[pl.ds(i * tile, tile)], gbuf, sem.at[1])
+    dx.start()
+    dg.start()
+    dx.wait()
+    dg.wait()
+
+
+def _conv_v2_plan(x_shape, g_shape, kernel_size, strides, padding,
+                  itemsize: int = 2):
+    """(rows, cols, rc, w8, wo8) if v2 can run this layer, else None."""
+    geo = _stage_geometry(x_shape, g_shape, kernel_size, strides, padding)
+    if geo is None:
+        return None
+    rows, cols, w8, wo8 = geo
+    c = x_shape[-1]
+    ho, k = g_shape[1], g_shape[3]
+    if c > 512 or k > 512:
+        return None
+    rc = max(1, min(ho, _V2_ROW_TARGET // wo8))
     tile = 8
     xbuf = rows * cols * c * itemsize
-    gbuf = ho * wo * (-(-k // 128) * 128) * itemsize
+    gbuf = ho * wo8 * (-(-k // 128) * 128) * itemsize
     macc = c * (-(-k // 128) * 128) * 4
-    temps = 2 * rc * wo * (c + (-(-k // 128) * 128)) * itemsize  # xs/gs reshapes
+    temps = 2 * rc * wo8 * (c + (-(-k // 128) * 128)) * itemsize  # reshapes
     if tile * (xbuf + gbuf + macc + temps) > _V2_VMEM_BUDGET:
         return None
-    return rows, cols, rc
+    return rows, cols, rc, w8, wo8
 
 
 def conv_grad_norm_v2_eligible(x_shape, g_shape, kernel_size, strides,
@@ -283,19 +326,7 @@ def _conv_v2_kernel(kh, kw, pt, plft, h, w, rc, use_bias,
     tile = gbuf.shape[0]
     ho, wo, k = gbuf.shape[1:]
     c = xbuf.shape[-1]
-
-    # Zero every step: borders must be zero and interpret mode does not
-    # guarantee scratch persistence across grid steps (on TPU this memset is
-    # ~µs against ~100µs of matmuls).
-    xbuf[...] = jnp.zeros_like(xbuf)
-    dx = pltpu.make_async_copy(
-        x_hbm.at[pl.ds(i * tile, tile)],
-        xbuf.at[:, pl.ds(pt, h), pl.ds(_V2_COL0, w), :], sem.at[0])
-    dg = pltpu.make_async_copy(g_hbm.at[pl.ds(i * tile, tile)], gbuf, sem.at[1])
-    dx.start()
-    dg.start()
-    dx.wait()
-    dg.wait()
+    _stage_dma(x_hbm, g_hbm, xbuf, gbuf, sem, i, tile, pt, h, w)
 
     first = True
     for oy in range(kh):
@@ -335,7 +366,9 @@ def conv_grad_norm_sq_v2(x: jax.Array, g: jax.Array, kernel_size, padding,
     plan = _conv_v2_plan(x.shape, g.shape, kernel_size, (1, 1), padding,
                          x.dtype.itemsize)
     assert plan is not None, "caller must check conv_grad_norm_v2_eligible"
-    rows, cols, rc = plan
+    rows, cols, rc, w8, wo8 = plan
+    x, g = _normalize_widths(x, g, w8, wo8)
+    w, wo = w8, wo8
     tile = 8
     (x, g), b_pad = _pad_batch([x, g], b, tile)
     out = pl.pallas_call(
@@ -350,6 +383,119 @@ def conv_grad_norm_sq_v2(x: jax.Array, g: jax.Array, kernel_size, padding,
             pltpu.VMEM((tile, rows, cols, c), x.dtype),
             pltpu.VMEM((tile, ho, wo, k), g.dtype),
             pltpu.VMEM((tile, c, k), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=_auto_interpret(interpret),
+    )(x, g)
+    return out[:b, 0]
+
+
+# --------------------------------------------------------------------------
+# Fused Gram-form conv weight-grad-norm kernel (small-S, wide-channel layers).
+# --------------------------------------------------------------------------
+#
+# For late layers (stage 4: S = 16 output positions, F·K ≈ 2.4M) the Gram form
+# ``‖PᵀG‖² = Σ_{ss'} (PPᵀ)_{ss'}(GGᵀ)_{ss'}`` costs ~15× fewer FLOPs than the
+# direct contraction, but XLA's version materializes the [B, S, F] patch tensor
+# and the [B, S, S] grams in HBM and was profiled at ~7 TF/s-equivalent. Here
+# the im2col patches are BUILT IN VMEM (scratch stores at o·C lane offsets —
+# aligned because every eligible layer has C a multiple of 128), the two tiny
+# grams and their dot stay in registers, and x/g are staged raw by the same
+# virtual-padding DMA as the v2 direct kernel.
+
+_GRAM_MAX_S = 64
+
+
+def _conv_gram_plan(x_shape, g_shape, kernel_size, strides, padding,
+                    itemsize: int = 2):
+    kh, kw = kernel_size
+    geo = _stage_geometry(x_shape, g_shape, kernel_size, strides, padding)
+    if geo is None:
+        return None
+    rows, cols, w8, wo8 = geo
+    c = x_shape[-1]
+    ho, k = g_shape[1], g_shape[3]
+    s = ho * wo8
+    if s > _GRAM_MAX_S:
+        return None
+    tile = 8
+    spad = -(-s // 8) * 8
+    vmem = tile * (rows * cols * c * itemsize          # xbuf
+                   + ho * wo8 * k * itemsize           # gbuf
+                   + spad * kh * kw * c * itemsize     # patches scratch
+                   + 3 * spad * max(spad, 128) * 4)    # pp, gg, product
+    if vmem > _V2_VMEM_BUDGET:
+        return None
+    return rows, cols, w8, wo8
+
+
+def conv_grad_norm_gram_eligible(x_shape, g_shape, kernel_size, strides,
+                                 padding, itemsize: int = 2) -> bool:
+    return _conv_gram_plan(x_shape, g_shape, kernel_size, strides, padding,
+                           itemsize) is not None
+
+
+def _conv_gram_kernel(kh, kw, pt, plft, h, w, use_bias,
+                      x_hbm, g_hbm, out_ref, xbuf, gbuf, pbuf, sem):
+    i = pl.program_id(0)
+    tile = gbuf.shape[0]
+    ho, wo, k = gbuf.shape[1:]
+    c = xbuf.shape[-1]
+    s = ho * wo
+    _stage_dma(x_hbm, g_hbm, xbuf, gbuf, sem, i, tile, pt, h, w)
+
+    # Patches in VMEM: pbuf[:, s, o*C:(o+1)*C] = shifted x window (lane offset
+    # o*C is 128-aligned for every eligible layer).
+    for oi, (oy, ox) in enumerate((oy, ox) for oy in range(kh)
+                                  for ox in range(kw)):
+        win = xbuf[:, oy:oy + ho,
+                   _V2_COL0 - plft + ox:_V2_COL0 - plft + ox + wo, :]
+        pbuf[:, :, oi * c:(oi + 1) * c] = win.reshape(tile, s, c)
+
+    p = pbuf[...]
+    g2 = gbuf[...].reshape(tile, s, k)
+    pp = jax.lax.dot_general(p, p, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    gg = jax.lax.dot_general(g2, g2, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    acc = jnp.sum(jnp.sum(pp * gg, axis=2), axis=1, keepdims=True)
+    if use_bias:
+        gsum = jnp.sum(g2.astype(jnp.float32), axis=1)
+        acc = acc + jnp.sum(gsum * gsum, axis=1, keepdims=True)
+    out_ref[...] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_size", "padding",
+                                             "use_bias", "interpret"))
+def conv_grad_norm_sq_gram(x: jax.Array, g: jax.Array, kernel_size, padding,
+                           use_bias: bool = False,
+                           interpret: bool | None = None) -> jax.Array:
+    """[B] ⟵ Gram-form ‖per-example conv weight gradient‖²_F (+ bias-grad²),
+    unit-stride conv, raw unpadded ``x``; see the design note above."""
+    kh, kw = kernel_size
+    (pt, _pb), (plft, _pr) = padding
+    b, h, w, c = x.shape
+    ho, wo, k = g.shape[1:]
+    plan = _conv_gram_plan(x.shape, g.shape, kernel_size, (1, 1), padding,
+                           x.dtype.itemsize)
+    assert plan is not None, "caller must check conv_grad_norm_gram_eligible"
+    rows, cols, w8, wo8 = plan
+    x, g = _normalize_widths(x, g, w8, wo8)
+    w, wo = w8, wo8
+    tile = 8
+    (x, g), b_pad = _pad_batch([x, g], b, tile)
+    out = pl.pallas_call(
+        functools.partial(_conv_gram_kernel, kh, kw, pt, plft, h, w, use_bias),
+        grid=(b_pad // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((tile, rows, cols, c), x.dtype),
+            pltpu.VMEM((tile, ho, wo, k), g.dtype),
+            pltpu.VMEM((tile, ho * wo, kh * kw * c), x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=_auto_interpret(interpret),

@@ -98,6 +98,7 @@ class TestConvGradNorm:
         (16, 128, 128, False),   # stage-2 geometry (v2's main target)
         (8, 256, 256, True),     # stage-3 geometry + fused bias term
         (8, 128, 256, False),    # channel-doubling stage entry (unit stride)
+        (12, 128, 128, False),   # 96px-style narrow map (W-normalized path)
     ])
     def test_v2_matches_xla(self, h, c, k, bias):
         """Raw-x DMA kernel (virtual padding, fused bias) vs the patch-einsum
@@ -118,6 +119,43 @@ class TestConvGradNorm:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=1e-5, atol=1e-3)
 
+    @pytest.mark.parametrize("h,c,k,bias", [
+        (8, 128, 128, False),    # small-S wide-channel (Gram regime)
+        (4, 256, 256, True),     # stage-4-like + W normalization (4 % 8 != 0)
+    ])
+    def test_gram_kernel_matches_xla(self, h, c, k, bias):
+        """Fused Gram-form kernel (patches built in VMEM) vs the patch-einsum
+        reference, including the narrow-map W padding path."""
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_grad_norm_gram_eligible, conv_grad_norm_sq_gram)
+        ks, st, pad = (3, 3), (1, 1), ((1, 1), (1, 1))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(10, h, h, c)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(10, h, h, k)).astype(np.float32))
+        assert conv_grad_norm_gram_eligible(x.shape, g.shape, ks, st, pad,
+                                            x.dtype.itemsize)
+        got = conv_grad_norm_sq_gram(x, g, ks, pad, use_bias=bias,
+                                     interpret=True)
+        ref = self._ref(x, g, ks, st, pad)
+        if bias:
+            gsum = jnp.sum(g.reshape(10, -1, k), axis=1)
+            ref = ref + jnp.sum(gsum * gsum, axis=-1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-2)
+
+    def test_gram_eligibility_gates(self):
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_grad_norm_gram_eligible)
+        pad = ((1, 1), (1, 1))
+        assert conv_grad_norm_gram_eligible(
+            (8, 4, 4, 512), (8, 4, 4, 512), (3, 3), (1, 1), pad, 2)
+        assert not conv_grad_norm_gram_eligible(
+            (8, 8, 8, 512), (8, 4, 4, 512), (3, 3), (2, 2), pad, 2)  # strided
+        assert not conv_grad_norm_gram_eligible(
+            (8, 16, 16, 128), (8, 16, 16, 128), (3, 3), (1, 1), pad, 2)  # S>64
+        assert not conv_grad_norm_gram_eligible(
+            (8, 4, 4, 192), (8, 4, 4, 512), (3, 3), (1, 1), pad, 2)  # c%128
+
     def test_v2_eligibility_gates(self):
         """v2 refuses strided convs and non-128-multiple channels (the HBM DMA
         cannot slice lane-padded memrefs); v1/XLA handle those."""
@@ -133,8 +171,9 @@ class TestConvGradNorm:
             (8, 16, 16, 64), (8, 16, 16, 128), (3, 3), (1, 1), pad, 2)  # c%128
         assert not conv_grad_norm_v2_eligible(
             (8, 16, 16, 128), (8, 16, 16, 64), (3, 3), (1, 1), pad, 2)  # k%128
-        assert not conv_grad_norm_v2_eligible(
-            (8, 12, 12, 256), (8, 12, 12, 256), (3, 3), (1, 1), pad, 2)  # w%8
+        # Narrow maps (96px geometries) are W-normalized, so eligible.
+        assert conv_grad_norm_v2_eligible(
+            (8, 12, 12, 256), (8, 12, 12, 256), (3, 3), (1, 1), pad, 2)
         assert not conv_grad_norm_v2_eligible(
             (8, 16, 16, 128), (8, 16, 16, 128), (19, 19), (1, 1),
             ((9, 9), (9, 9)), 2)                       # left pad > interior col

@@ -90,10 +90,15 @@ def test_scores_match_torch_oracle_on_real_data(real_run):
                     torch.tensor(y))
     rho = spearman(scores[:n], th)
     # Artifact FIRST (next to the data, where README says it lives — and so a
-    # near-miss rho still leaves the evidence on disk), assertion after.
-    np.savez(os.path.join(_DATA_DIR, "real_cifar_scores.npz"),
-             scores=scores, indices=sub.indices, rho=rho,
-             accuracy=res.final_test_accuracy)
+    # near-miss rho still leaves the evidence on disk), assertion after. A
+    # read-only data mount falls back to the test's tmp dir rather than
+    # masking the rho result with a filesystem error.
+    payload = dict(scores=scores, indices=sub.indices, rho=rho,
+                   accuracy=res.final_test_accuracy)
+    try:
+        np.savez(os.path.join(_DATA_DIR, "real_cifar_scores.npz"), **payload)
+    except OSError:
+        np.savez(os.path.join(str(tmp), "real_cifar_scores.npz"), **payload)
     assert rho >= 0.98, rho
 
 
