@@ -127,6 +127,12 @@ class MeshConfig:
 
     data_axis: int | None = None     # None -> n_devices // model_axis
     model_axis: int = 1
+    # ZeRO-1-style optimizer-state sharding over the data axis: each DP rank
+    # holds 1/data_axis of the momentum buffers (params stay replicated; XLA
+    # gathers the sharded slots where the update needs them). Off by default —
+    # it trades one all-gather per step for optimizer memory, which only pays
+    # once params are a meaningful fraction of HBM.
+    shard_opt_state: bool = False
     # Multi-host: call jax.distributed.initialize() before device queries.
     multihost: bool = False
     coordinator_address: str | None = None

@@ -100,14 +100,32 @@ def _path_names(path) -> tuple:
     return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
 
-def place_state(state, mesh: Mesh):
+def _zero1_spec(spec: P, shape, data_size: int) -> P:
+    """Add ``data``-axis sharding to an optimizer-slot spec (ZeRO-1): shard the
+    first unsharded dim divisible by the data-axis size; unchanged if none is."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for d, (entry, dim) in enumerate(zip(entries, shape)):
+        if entry is None and dim % data_size == 0 and dim >= data_size:
+            entries[d] = DATA_AXIS
+            return P(*entries)
+    return spec
+
+
+def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
     """Device-place a TrainState: params AND their optimizer slots per
     ``param_specs``; everything else replicated. This is the production placement
     used by ``fit`` (the reference's equivalent surface is DDP model wrapping,
-    ``ddp.py:133-164``); with ``model_axis == 1`` it degenerates to ``replicate``.
+    ``ddp.py:133-164``); with ``model_axis == 1`` and no optimizer sharding it
+    degenerates to ``replicate``.
+
+    ``shard_opt_state`` (ZeRO-1): momentum/accumulator slots additionally shard
+    over ``data`` — each DP rank holds ``1/data_axis`` of the optimizer memory;
+    params stay replicated and XLA gathers the slots where the update needs
+    them (one all-gather per step, bought for optimizer memory).
     """
     tp = mesh.shape[MODEL_AXIS] > 1
-    if not tp:
+    zero1 = shard_opt_state and mesh.shape[DATA_AXIS] > 1
+    if not tp and not zero1:
         return replicate(state, mesh)
     specs = param_specs(state.params, mesh)
     by_path = {
@@ -126,10 +144,16 @@ def place_state(state, mesh: Mesh):
         # TP-sharded kernel is sharded identically — a replicated slot would
         # make every SGD update all-gather the gradient back.
         names = _path_names(path)
+        spec = P()
         for i in range(len(names)):
             if names[i:] in by_path:
-                return by_path[names[i:]]
-        return P()
+                spec = by_path[names[i:]]
+                break
+        else:
+            return P()   # non-param slot (schedule counts, ...): replicated
+        if zero1 and hasattr(leaf, "shape"):
+            spec = _zero1_spec(spec, leaf.shape, mesh.shape[DATA_AXIS])
+        return spec
 
     params = put(state.params, specs)
     opt_state = put(state.opt_state, jax.tree_util.tree_map_with_path(

@@ -137,8 +137,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     # Production placement: replicated under pure DP; classifier (and its
     # optimizer slots) tensor-parallel over 'model' when the mesh has one —
     # the train/eval jits then partition the head matmul and gather logits
-    # via compiler-inserted collectives.
-    state = place_state(state, mesh)
+    # via compiler-inserted collectives. mesh.shard_opt_state adds ZeRO-1
+    # optimizer-state sharding over the data axis.
+    state = place_state(state, mesh, shard_opt_state=cfg.mesh.shard_opt_state)
 
     ckpt = None
     start_epoch = 0

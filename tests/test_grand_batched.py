@@ -66,6 +66,50 @@ def test_batched_matches_vmap_wideresnet():
                                rtol=2e-4, atol=1e-5)
 
 
+class _WideChannelCNN(nn.Module):
+    """Covers the DMA-kernel dispatch tiers inside the FULL algorithm: a
+    128-channel unit-stride conv (v2 direct), a 256-channel small-map conv
+    (fused Gram), plus stem/strided layers (v1/XLA fallbacks)."""
+
+    @nn.compact
+    def __call__(self, x, *, train=False, capture_features=False):
+        x = nn.Conv(128, (3, 3), strides=(2, 2), padding=1, use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+        x = nn.Conv(128, (3, 3), padding=1, use_bias=True)(x)      # v2 tier
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), strides=(2, 2), padding=1, use_bias=False)(x)
+        x = nn.Conv(256, (3, 3), padding=1, use_bias=True)(x)      # Gram tier
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(10, name="classifier")(x)
+        if capture_features:
+            return logits, x
+        return logits
+
+
+def test_batched_with_pallas_kernels_matches_vmap_wide_channels():
+    """End-to-end batched GraNd with use_pallas=True on a net whose layers hit
+    the v2 direct kernel AND the fused Gram kernel (interpret mode on CPU) —
+    tiny_cnn alone never reaches the 128-multiple-channel tiers."""
+    from data_diet_distributed_tpu.ops.grand_batched import batched_grand_scores
+    from data_diet_distributed_tpu.ops.pallas_kernels import (
+        conv_grad_norm_gram_eligible, conv_grad_norm_v2_eligible)
+
+    model = _WideChannelCNN()
+    batch = _batch(8, 16, seed=7)
+    variables = _trained_stats(model, _init(model, 16), batch)
+    # Sanity: the intended tiers are actually eligible for these geometries.
+    assert conv_grad_norm_v2_eligible((8, 8, 8, 128), (8, 8, 8, 128), (3, 3),
+                                      (1, 1), ((1, 1), (1, 1)), 4)
+    assert conv_grad_norm_gram_eligible((8, 4, 4, 256), (8, 4, 4, 256), (3, 3),
+                                        (1, 1), ((1, 1), (1, 1)), 4)
+    fast = jax.jit(lambda v, b: batched_grand_scores(
+        model, v, b["image"], b["label"], b["mask"], use_pallas=True))(
+            variables, batch)
+    ref = make_grand_step(model, chunk=4)(variables, batch)
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+
+
 def test_masked_rows_score_zero():
     model = create_model("tiny_cnn", 10)
     batch = _batch(8, 16, seed=1)
