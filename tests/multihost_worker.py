@@ -85,6 +85,10 @@ def main() -> None:
         "train.device_resident_data=false", "train.log_every_steps=1000",
         f"train.checkpoint_dir={out_dir}/ckpt",
         "score.pretrain_epochs=0", "score.batch_size=64",
+        # TP variant also turns on ZeRO-1: optimizer slots shard over a data
+        # axis that SPANS the two processes (numerics ≡ replicated, so the
+        # parent's DP-vs-TP equality assertions double as the ZeRO-1 check).
+        f"mesh.shard_opt_state={'true' if model_axis > 1 else 'false'}",
     ])
 
     # Streaming fit across both processes: every process feeds its slice of

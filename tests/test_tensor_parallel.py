@@ -126,9 +126,8 @@ def test_zero1_through_fit(tiny_cfg, tiny_ds, mesh8):
 
     train_ds, _ = tiny_ds
     res_base = fit(tiny_cfg, train_ds, None, mesh=mesh8)
-    tiny_cfg.mesh.shard_opt_state = True
+    tiny_cfg.mesh.shard_opt_state = True   # fixture is function-scoped
     res_z1 = fit(tiny_cfg, train_ds, None, mesh=mesh8)
-    tiny_cfg.mesh.shard_opt_state = False
     assert res_z1.history[-1]["train_loss"] == pytest.approx(
         res_base.history[-1]["train_loss"], rel=1e-5)
 
