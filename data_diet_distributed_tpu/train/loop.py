@@ -298,6 +298,15 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
                        saved_steps=saved_steps, **kwargs)
         except Exception as err:  # noqa: BLE001 — any step failure is recoverable
             attempt += 1
+            if jax.process_count() > 1:
+                # In-process retry is single-host only: one process re-entering
+                # fit while its peers continue (or died) desyncs every
+                # collective. Multi-host recovery is restart-the-job +
+                # train.resume=true — the checkpoints this run wrote make that
+                # exact (SURVEY §5.3; PARITY.md 'Failure detection/recovery').
+                logger.log("recovery_refused", reason="multihost",
+                           attempt=attempt, error=repr(err)[:300])
+                raise
             if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
                 raise
             # Saves are async: a step lands in saved_steps when dispatched, but

@@ -46,6 +46,28 @@ def test_recovery_exhausts_retries(tiny_cfg, tiny_ds, mesh8, tmp_path, monkeypat
                           checkpoint_dir=str(tmp_path / "x"), mesh=mesh8)
 
 
+def test_recovery_refuses_in_process_retry_multihost(tiny_cfg, tiny_ds, mesh8,
+                                                     tmp_path, monkeypatch):
+    """Under a multi-process runtime, an in-process retry would desync every
+    collective (one process re-enters fit while peers continue/died), so
+    fit_with_recovery re-raises immediately; multi-host recovery is
+    restart-the-job + train.resume=true."""
+    train_ds, _ = tiny_ds
+    tiny_cfg.train.auto_resume_retries = 3
+    calls = {"n": 0}
+
+    def failing_fit(*args, **kwargs):
+        calls["n"] += 1
+        raise RuntimeError("injected multi-host failure")
+
+    monkeypatch.setattr(loop_mod, "fit", failing_fit)
+    monkeypatch.setattr(loop_mod.jax, "process_count", lambda: 2)
+    with pytest.raises(RuntimeError, match="multi-host failure"):
+        fit_with_recovery(tiny_cfg, train_ds, None,
+                          checkpoint_dir=str(tmp_path / "mh"), mesh=mesh8)
+    assert calls["n"] == 1   # no retry attempted
+
+
 def test_recovery_ignores_stale_checkpoint(tiny_cfg, tiny_ds, mesh8, tmp_path,
                                            monkeypatch):
     """A checkpoint left by a PREVIOUS run must not satisfy the retry: resume from
