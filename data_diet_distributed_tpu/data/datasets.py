@@ -62,13 +62,45 @@ class ArrayDataset:
                        indices=self.indices[pos])
 
 
+def make_position_joiner(index_arr: np.ndarray):
+    """A reusable ``global ids -> positions in index_arr`` mapper.
+
+    Dense id spaces get an O(max_id) lookup table; a SPARSE bring-your-own npz
+    id space (max_id ≫ n) would make that table the dominant allocation, so it
+    gets a sorted join instead — setup O(n log n), memory O(n)."""
+    n = len(index_arr)
+    max_id = int(index_arr.max()) if n else 0
+    if max_id + 1 <= 4 * n + 1024:
+        lookup = np.full(max_id + 1, -1, np.int64)
+        lookup[index_arr] = np.arange(n)
+
+        def join(wanted: np.ndarray) -> np.ndarray:
+            wanted = np.asarray(wanted)
+            # Range-check first: out-of-range ids must be the same KeyError the
+            # sparse path raises (not IndexError; negative ids must not wrap).
+            if wanted.size and (
+                    (wanted < 0).any() or (wanted > max_id).any()):
+                raise KeyError("requested global indices not present in dataset")
+            pos = lookup[wanted]
+            if (pos < 0).any():
+                raise KeyError("requested global indices not present in dataset")
+            return pos
+        return join
+
+    order = np.argsort(index_arr, kind="stable")
+    sorted_ids = index_arr[order]
+
+    def join(wanted: np.ndarray) -> np.ndarray:
+        slot = np.searchsorted(sorted_ids, wanted)
+        ok = (slot < n) & (sorted_ids[np.minimum(slot, n - 1)] == wanted)
+        if not ok.all():
+            raise KeyError("requested global indices not present in dataset")
+        return order[slot]
+    return join
+
+
 def _positions_of(index_arr: np.ndarray, wanted: np.ndarray) -> np.ndarray:
-    lookup = np.full(index_arr.max() + 1, -1, np.int64)
-    lookup[index_arr] = np.arange(len(index_arr))
-    pos = lookup[wanted]
-    if (pos < 0).any():
-        raise KeyError("requested global indices not present in dataset")
-    return pos
+    return make_position_joiner(index_arr)(wanted)
 
 
 def _load_cifar_batches(data_dir: str, name: str):

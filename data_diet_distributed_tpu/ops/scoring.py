@@ -15,7 +15,7 @@ from collections.abc import Sequence
 import jax
 import numpy as np
 
-from ..data.datasets import ArrayDataset
+from ..data.datasets import ArrayDataset, make_position_joiner
 from ..data.pipeline import BatchSharder, iterate_batches
 from .scores import make_score_step
 
@@ -72,9 +72,9 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
 
     n = len(ds)
     total = np.zeros(n, np.float64)
-    # Position-in-ds lookup for joining batch scores back by global index.
-    pos_of = np.full(int(ds.indices.max()) + 1, -1, np.int64)
-    pos_of[ds.indices] = np.arange(n)
+    # Position-in-ds join for batch scores by global index; handles sparse
+    # bring-your-own id spaces without an O(max_id) table.
+    pos_of = make_position_joiner(ds.indices)
 
     if device_resident is None:
         # Batches shard over every flattened mesh axis, so the per-device
@@ -103,7 +103,7 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         def flush():
             for (idx, mask, _), scores in zip(
                     pending, _to_host([p[2] for p in pending])):
-                total[pos_of[idx[mask]]] += scores[mask]
+                total[pos_of(idx[mask])] += scores[mask]
             pending.clear()
 
         for idx, mask, batch in (resident if resident is not None
