@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the scoring hot path.
 
-Three fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
+Five fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
 
 * ``el2n_pallas`` — fused ``softmax -> subtract one-hot -> row L2 norm -> mask``
   over logits. One VMEM round-trip instead of four HBM-materialized intermediates.
@@ -9,17 +9,22 @@ Three fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventi
   MXU against the classifier weights and the score math runs on the VPU before
   logits ever leave VMEM. The model's own Dense head output goes unused and is
   dead-code-eliminated under jit, so the classifier matmul happens exactly once.
-* ``conv_grad_norm_sq_pallas`` — the batched-GraNd conv hot loop
+* ``conv_grad_norm_sq_pallas`` (v1) — the batched-GraNd conv hot loop
   (``grand_batched.py``): per-example Frobenius norm² of the conv weight
   gradient ``P_iᵀ G_i`` WITHOUT materializing the im2col patches or the [F, K]
   gradient in HBM. Key identity: writing ``M_o = Σ_s x_i[s·stride + o] g_i[s]``
   for each kernel offset ``o``, the full norm decomposes as
   ``‖∂W‖² = Σ_o ‖M_o‖²`` — each ``M_o`` is one small [C, K] MXU contraction over
-  output positions, accumulated and squared entirely in VMEM. HBM traffic is
-  exactly one read of ``x`` and ``g`` and one [B] write (the XLA patch-einsum
-  path writes+reads a 9×-expanded patch tensor plus a [B, F, K] float32 M).
-  Strided convs are decomposed into ``stride²`` unit-stride phase sub-problems
+  output positions, accumulated and squared entirely in VMEM. Takes pre-padded
+  x; strided convs decompose into ``stride²`` unit-stride phase sub-problems
   (each offset belongs to exactly one phase; Mosaic rejects strided 4D slices).
+* ``conv_grad_norm_sq_v2`` — same quantity for unit-stride 128-multiple-channel
+  layers from RAW unpadded x: the kernel stages x itself by manual DMA into a
+  zero-bordered VMEM buffer (virtual padding — no XLA pad, no layout copy per
+  layer) and fuses the bias-gradient term.
+* ``conv_grad_norm_sq_gram`` — the Gram form ``Σ(PPᵀ∘GGᵀ)`` for small-S
+  wide-channel layers (stage 4), patches built IN VMEM via aligned scratch
+  stores; the tiny grams never touch HBM. Shares the v2 staging helpers.
 
 All kernels tile the batch dimension (fp32-aligned tiles) and keep channel
 dimensions whole (Mosaic pads the lane dimension internally). Padded batch rows
