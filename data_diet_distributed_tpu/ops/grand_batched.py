@@ -52,6 +52,25 @@ import jax.numpy as jnp
 
 _F32 = jnp.float32
 
+# Composition toggles (module-level; DDT_GRAND_* env vars override so on-chip
+# perf bisection can flip them per bench run without code edits).
+# Conservative defaults: each True value must EARN its place by measured
+# full-pass wins on v5e — individually-faster kernels have been observed to
+# compose into a slower pass (layout/fusion interactions), so composition is
+# bisected on hardware, not assumed.
+import os as _os
+
+
+def _toggle(name: str, default: bool) -> bool:
+    v = _os.environ.get(name)
+    return default if v is None else v not in ("0", "false", "False")
+
+
+GROUP_CONV = _toggle("DDT_GRAND_GROUP_CONV", False)
+GROUP_BN = _toggle("DDT_GRAND_GROUP_BN", False)
+USE_BN_KERNEL = _toggle("DDT_GRAND_BN_KERNEL", False)
+USE_CATDOT = _toggle("DDT_GRAND_CATDOT", False)
+
 
 def _canon_tuple(v, n: int) -> tuple:
     if v is None:
@@ -183,6 +202,11 @@ def _explicit_padding(padding, x: jax.Array, g: jax.Array, rec: dict):
 _DIRECT_OVER_GRAM_MAX_RATIO = 8.0
 
 
+def _conv_bias_term(g: jax.Array, batch: int, s: int) -> jax.Array:
+    """[B] squared norm of the per-example conv bias gradient ``Σ_s g``."""
+    return _sq(jnp.sum(g.astype(_F32).reshape(batch, s, -1), axis=1), axis=-1)
+
+
 def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
                   use_pallas: bool = False) -> jax.Array:
     """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
@@ -195,13 +219,31 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
     # not-gram case satisfies this by definition: f*k <= s*(f+k)).
     direct_ok = f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
     if use_pallas:
-        from .pallas_kernels import (conv_grad_norm_gram_eligible,
+        from .pallas_kernels import (_catdot_ok, conv_grad_norm_gram_eligible,
                                      conv_grad_norm_pallas_fits,
                                      conv_grad_norm_sq_gram,
                                      conv_grad_norm_sq_pallas,
                                      conv_grad_norm_sq_v2,
                                      conv_grad_norm_v2_eligible)
         pad = _explicit_padding(rec["padding"], x, g, rec)
+        ho, wo = g.shape[1:3]
+        strides = tuple(rec["strides"])
+        if (USE_CATDOT and direct_ok and strides == (1, 1) and s >= 256
+                and _catdot_ok(x.shape[1] + pad[0][0] + pad[0][1],
+                               x.shape[2] + pad[1][0] + pad[1][1],
+                               x.shape[-1], ho, wo, k,
+                               *rec["kernel_size"], x.dtype.itemsize)
+                and conv_grad_norm_pallas_fits(
+                    x.shape, g.shape, rec["kernel_size"], strides,
+                    x.dtype.itemsize)):
+            # Cat-dot beats the v2 direct kernel for deep-contraction
+            # 128-aligned layers (stage-2 geometry: 53 vs 46 TF/s measured);
+            # shallower layers stay on v2/Gram below.
+            contrib = conv_grad_norm_sq_pallas(
+                x, g, tuple(rec["kernel_size"]), strides, pad, catdot=True)
+            if rec["use_bias"]:
+                contrib = contrib + _conv_bias_term(g, batch, s)
+            return contrib
         if direct_ok and conv_grad_norm_v2_eligible(
                 x.shape, g.shape, rec["kernel_size"], rec["strides"], pad,
                 x.dtype.itemsize):
@@ -222,9 +264,7 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
             contrib = conv_grad_norm_sq_pallas(
                 x, g, tuple(rec["kernel_size"]), tuple(rec["strides"]), pad)
             if rec["use_bias"]:
-                contrib = contrib + _sq(
-                    jnp.sum(g.astype(_F32).reshape(batch, s, -1), axis=1),
-                    axis=-1)
+                contrib = contrib + _conv_bias_term(g, batch, s)
             return contrib
     patches = jax.lax.conv_general_dilated_patches(
         x, filter_shape=rec["kernel_size"], window_strides=rec["strides"],
@@ -233,8 +273,7 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
     contrib = _matrix_grad_norm_sq(patches.reshape(batch, s, patches.shape[-1]),
                                    g.reshape(batch, s, g.shape[-1]))
     if rec["use_bias"]:
-        contrib = contrib + _sq(jnp.sum(g.astype(_F32).reshape(batch, s, -1),
-                                        axis=1), axis=-1)
+        contrib = contrib + _conv_bias_term(g, batch, s)
     return contrib
 
 
@@ -260,6 +299,46 @@ def _dense_contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
         gb = g.astype(_F32).reshape(g.shape[0], -1, g.shape[-1]).sum(axis=1)
         contrib = contrib + _sq(gb, axis=-1)
     return contrib
+
+
+def _bn_stats(rec: dict, batch_stats) -> tuple[jax.Array, jax.Array]:
+    scope = reduce(lambda d, k: d[k], rec["path"], batch_stats)
+    return (scope["mean"].astype(_F32),
+            jax.lax.rsqrt(scope["var"].astype(_F32) + rec["epsilon"]))
+
+
+def _bn_group_contrib(items, batch_stats, use_pallas: bool) -> jax.Array:
+    """Σ over same-shape BatchNorm layers of the per-example grad-norm².
+
+    ``items`` is a list of ``(rec, x, g)`` with identical activation shapes;
+    with Pallas available they are stacked along the batch and scored by ONE
+    ``bn_grad_norm_sq_pallas`` launch (per-layer (μ, rstd) rows indexed by
+    segment) instead of one XLA fusion per layer."""
+    rec0, x0, _ = items[0]
+    b = x0.shape[0]
+    if use_pallas and USE_BN_KERNEL and x0.ndim == 4:
+        from .pallas_kernels import bn_grad_norm_fits, bn_grad_norm_sq_pallas
+        if bn_grad_norm_fits(x0.shape, x0.dtype.itemsize):
+            b8 = -(-b // 8) * 8
+
+            def padb(a):
+                return jnp.pad(a, ((0, b8 - b),) + ((0, 0),) * (a.ndim - 1))
+
+            xs = jnp.concatenate([padb(x) for _, x, _ in items], axis=0)
+            gs = jnp.concatenate([padb(g) for _, _, g in items], axis=0)
+            # [L, 8, C] stats slabs: rows 0/1 = (mean, rstd), rest sublane pad.
+            stats = jnp.pad(
+                jnp.stack([jnp.stack(_bn_stats(rec, batch_stats))
+                           for rec, _, _ in items]),
+                ((0, 0), (0, 6), (0, 0)))
+            out = bn_grad_norm_sq_pallas(xs, gs, stats, b8,
+                                         use_scale=rec0["use_scale"],
+                                         use_bias=rec0["use_bias"])
+            return jnp.sum(out.reshape(len(items), b8)[:, :b], axis=0)
+    total = jnp.zeros(b, _F32)
+    for rec, x, g in items:
+        total = total + _bn_contrib(rec, x, g, batch_stats)
+    return total
 
 
 def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array:
@@ -338,13 +417,40 @@ def batched_grand_scores(model, variables, image, label, mask,
 
     batch_stats = variables.get("batch_stats", {})
     norm_sq = jnp.zeros(image.shape[0], _F32)
+    # Same-geometry layers are GROUPED into one kernel launch (batch-concat):
+    # a ResNet's stages repeat identical conv/BN shapes 3-5×, and per-launch
+    # overhead (dispatch + layout transitions around each Pallas call) was
+    # profiled at ~⅓ of the round-3 scoring pass. Conv groups concatenate
+    # along the batch; BN groups additionally stack per-layer statistics
+    # (see _bn_group_contrib). Summation order changes only across layers
+    # (f32 accumulation, same magnitudes — well below score tolerance).
+    conv_groups: dict[tuple, list] = {}
+    bn_groups: dict[tuple, list] = {}
     for rec in records:
         x = _leaf(captures, rec["path"], "x")   # sow reduce_fn stores the raw array
         g = _leaf(cotangents, rec["path"], "y")
         if rec["kind"] == "conv":
-            norm_sq = norm_sq + _conv_contrib(rec, x, g, use_pallas)
+            key = (x.shape, g.shape, rec["kernel_size"], rec["strides"],
+                   rec["padding"], rec["use_bias"],
+                   rec["path"] if not GROUP_CONV else None)
+            conv_groups.setdefault(key, []).append((rec, x, g))
         elif rec["kind"] == "dense":
             norm_sq = norm_sq + _dense_contrib(rec, x, g)
         else:
-            norm_sq = norm_sq + _bn_contrib(rec, x, g, batch_stats)
+            key = (x.shape, rec["epsilon"], rec["use_scale"], rec["use_bias"],
+                   rec["path"] if not GROUP_BN else None)
+            bn_groups.setdefault(key, []).append((rec, x, g))
+    for items in conv_groups.values():
+        rec = items[0][0]
+        if len(items) == 1:
+            norm_sq = norm_sq + _conv_contrib(rec, items[0][1], items[0][2],
+                                              use_pallas)
+        else:
+            xs = jnp.concatenate([x for _, x, _ in items], axis=0)
+            gs = jnp.concatenate([g for _, _, g in items], axis=0)
+            contrib = _conv_contrib(rec, xs, gs, use_pallas)
+            norm_sq = norm_sq + jnp.sum(
+                contrib.reshape(len(items), image.shape[0]), axis=0)
+    for items in bn_groups.values():
+        norm_sq = norm_sq + _bn_group_contrib(items, batch_stats, use_pallas)
     return jnp.sqrt(norm_sq) * mask

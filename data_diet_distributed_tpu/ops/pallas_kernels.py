@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the scoring hot path.
 
-Five fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
+Seven fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
 
 * ``el2n_pallas`` — fused ``softmax -> subtract one-hot -> row L2 norm -> mask``
   over logits. One VMEM round-trip instead of four HBM-materialized intermediates.
@@ -25,6 +25,12 @@ Five fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventio
 * ``conv_grad_norm_sq_gram`` — the Gram form ``Σ(PPᵀ∘GGᵀ)`` for small-S
   wide-channel layers (stage 4), patches built IN VMEM via aligned scratch
   stores; the tiny grams never touch HBM. Shares the v2 staging helpers.
+* ``_conv_norm_catdot_kernel`` (dispatched inside ``conv_grad_norm_sq_pallas``)
+  — the cross-product "cat-dot" form for 128-aligned deep-contraction layers:
+  one ``[kh·C, kw·K]`` dot computes every kernel-offset's weight-grad block at
+  once with zero wasted FLOPs (see its docstring for the identity).
+* ``bn_grad_norm_sq_pallas`` — eval-mode BatchNorm per-example grad-norm² in
+  one VMEM pass, with same-shape layers stackable into a single launch.
 
 All kernels tile the batch dimension (fp32-aligned tiles) and keep channel
 dimensions whole (Mosaic pads the lane dimension internally). Padded batch rows
@@ -124,6 +130,46 @@ def _conv_norm_kernel(kh, kw, x_ref, g_ref, out_ref):
     out_ref[...] = total
 
 
+def _conv_norm_catdot_kernel(kh, kw, x_ref, g_ref, out_ref):
+    """Cross-product "cat-dot" form: ONE dot computes ALL kh·kw offset blocks.
+
+    Key identity: lane-concatenate the ``kh`` ROW-shifted views of padded x
+    (row slices — the H dim is untiled, so these are free offsets) into
+    ``A [S', kh·C]`` with ``S' = Ho·Wp``, and the ``kw`` COLUMN-shifted
+    zero-embedded copies of g into ``G [S', kw·K]``. Then
+    ``(AᵀG)[(oy,c'),(ox,k')] = Σ_{r,w} x[r+oy, w+ox, c'] · g[r, w, k']
+    = M_{(oy,ox)}[c',k']`` — every [C, K] block of the single ``[kh·C, kw·K]``
+    product is exactly one kernel-offset's per-example weight-grad matrix, so
+    ``‖∂W‖² = Σ (AᵀG)²`` with NO wasted cross terms. Versus the per-offset
+    kernel this replaces kh·kw quarter-filled [C, K] dots (25% MXU fill at
+    C = K = 64) with one [kh·C, kw·K] dot (56% fill at stage-1 geometry,
+    100% at C = K = 128) and materializes 2 concatenated operands instead of
+    kh·kw shifted windows. Cost over the direct form: only the Wp/Wo
+    contraction-padding ratio (≈ 6%). Needs a raised scoped-VMEM limit for
+    the wide operands — set via compiler_params at the call site."""
+    xb = x_ref[...]                       # [TB, Hp, Wp, C]
+    gb = g_ref[...]                       # [TB, Ho, Wo, K]
+    tb, ho, wo, k = gb.shape
+    wp = xb.shape[2]
+    a = jnp.concatenate([xb[:, oy:oy + ho] for oy in range(kh)], axis=-1) \
+        if kh > 1 else xb[:, :ho]
+    gcols = []
+    for ox in range(kw):
+        parts = []
+        if ox:
+            parts.append(jnp.zeros((tb, ho, ox, k), gb.dtype))
+        parts.append(gb)
+        if wp - wo - ox:
+            parts.append(jnp.zeros((tb, ho, wp - wo - ox, k), gb.dtype))
+        gcols.append(jnp.concatenate(parts, axis=2) if len(parts) > 1 else gb)
+    g_cat = jnp.concatenate(gcols, axis=-1) if kw > 1 else gcols[0]
+    m = jax.lax.dot_general(              # [TB, kh·C, kw·K]
+        a.reshape(tb, ho * wp, -1), g_cat.reshape(tb, ho * wp, -1),
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    out_ref[...] = jnp.sum(jnp.sum(m * m, axis=2), axis=1, keepdims=True)
+
+
 def _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) -> int:
     """Largest batch tile whose working set fits the VMEM budget (0 = none).
 
@@ -139,16 +185,64 @@ def _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) -> int:
     return 0
 
 
-def _unit_stride_norm_sq(x_pad, g, kh, kw, interpret):
+# The 16 MiB scoped-VMEM default is a compiler knob, not the hardware size —
+# v5e compiles and runs these kernels with a raised limit. The cat-dot kernel
+# trades VMEM (wide concatenated operands) for MXU fill, so it asks for more.
+_CATDOT_VMEM_CAP = 96 << 20
+
+
+def _catdot_vmem(hp, wp, c, ho, wo, k, kh, kw, itemsize) -> int:
+    """Estimated scoped-VMEM bytes for the cat-dot kernel at batch tile 8."""
+    lane, tile = 128, 8
+
+    def pad8(v):
+        return -(-v // 8) * 8
+
+    def padl(v):
+        return -(-v // lane) * lane
+
+    cpad, kpad = padl(c), padl(k)
+    blocks = 2 * tile * (hp * pad8(wp) * cpad
+                         + ho * pad8(wo) * kpad) * itemsize    # double-buffered
+    acat = tile * ho * pad8(wp) * padl(kh * c) * itemsize
+    gcat = tile * ho * pad8(wp) * padl(kw * k) * itemsize
+    m = tile * pad8(kh * c) * padl(kw * k) * 4
+    # gcols temps roughly double g_cat during the build.
+    return blocks + acat + 2 * gcat + m
+
+
+def _catdot_ok(hp, wp, c, ho, wo, k, kh, kw, itemsize) -> bool:
+    """Whether the cat-dot kernel applies: multi-offset conv with 128-aligned
+    channels (the lane concatenations are then tile-appends; measured on-chip,
+    64-channel operands relayout so heavily the per-offset kernel wins) and
+    enough contraction depth to keep the MXU pipeline fed (short-S layers are
+    latency-bound and belong to the v2/Gram kernels), fitting the raised
+    VMEM cap."""
+    if kh * kw < 2 or ho * wp < 128 or c % 128 or k % 128:
+        return False
+    return _catdot_vmem(hp, wp, c, ho, wo, k, kh, kw, itemsize) <= _CATDOT_VMEM_CAP
+
+
+def _unit_stride_norm_sq(x_pad, g, kh, kw, interpret, catdot=False):
     """One pallas_call: all (kh, kw) offsets at unit stride. x_pad [B,Hp,Wp,C]
-    must satisfy Hp >= kh-1+Ho, Wp >= kw-1+Wo."""
+    must satisfy Hp >= kh-1+Ho, Wp >= kw-1+Wo. ``catdot`` selects the
+    cross-product cat-dot kernel — the CALLER decides (and must have checked
+    ``_catdot_ok``); the default is the per-offset kernel."""
     b, hp, wp, c = x_pad.shape
     ho, wo, k = g.shape[1:]
     tile = _conv_tile_b(hp, wp, c, ho, wo, k, x_pad.dtype.itemsize)
     assert tile > 0, "caller must check conv_grad_norm_pallas_fits first"
+    if catdot:
+        assert _catdot_ok(hp, wp, c, ho, wo, k, kh, kw, x_pad.dtype.itemsize)
     (x_pad, g), b_pad = _pad_batch([x_pad, g], b, tile)
+    if catdot:
+        kernel = functools.partial(_conv_norm_catdot_kernel, kh, kw)
+        params = pltpu.CompilerParams(vmem_limit_bytes=_CATDOT_VMEM_CAP)
+    else:
+        kernel = functools.partial(_conv_norm_kernel, kh, kw)
+        params = None
     out = pl.pallas_call(
-        functools.partial(_conv_norm_kernel, kh, kw),
+        kernel,
         grid=(b_pad // tile,),
         in_specs=[
             pl.BlockSpec((tile, hp, wp, c), lambda i: (i, 0, 0, 0),
@@ -159,6 +253,7 @@ def _unit_stride_norm_sq(x_pad, g, kh, kw, interpret):
         out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        compiler_params=params,
         interpret=_auto_interpret(interpret),
     )(x_pad, g)
     return out[:b, 0]
@@ -187,9 +282,10 @@ def conv_grad_norm_pallas_fits(x_shape, g_shape, kernel_size, strides,
 
 
 @functools.partial(jax.jit, static_argnames=("kernel_size", "strides", "padding",
-                                             "interpret"))
+                                             "interpret", "catdot"))
 def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
-                             padding, interpret: bool | None = None) -> jax.Array:
+                             padding, interpret: bool | None = None,
+                             catdot: bool = False) -> jax.Array:
     """[B] ⟵ ‖per-example conv weight gradient‖²_F, fully fused in VMEM.
 
     ``x`` [B, H, W, C] is the conv input, ``g`` [B, Ho, Wo, K] the per-example
@@ -197,7 +293,8 @@ def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
     Strided convs run as ``sy*sx`` unit-stride phase calls: offset (oy, ox)
     belongs to phase (oy % sy, ox % sx) and becomes offset (oy//sy, ox//sx) on
     the phase-strided input — the offsets of one phase are contiguous, so each
-    phase is a smaller unit-stride kernel.
+    phase is a smaller unit-stride kernel. ``catdot`` (unit-stride only,
+    caller must have checked ``_catdot_ok``) selects the cross-product kernel.
     """
     kh, kw = kernel_size
     sy, sx = strides
@@ -205,7 +302,7 @@ def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
     x_pad = jnp.pad(x, ((0, 0), padding[0], padding[1], (0, 0)))
     if sy == 1 and sx == 1:
         return _unit_stride_norm_sq(_grow(x_pad, kh - 1 + ho, kw - 1 + wo),
-                                    g, kh, kw, interpret)
+                                    g, kh, kw, interpret, catdot=catdot)
     total = jnp.zeros(x.shape[0], jnp.float32)
     for py in range(sy):
         for px in range(sx):
@@ -506,6 +603,95 @@ def conv_grad_norm_sq_gram(x: jax.Array, g: jax.Array, kernel_size, padding,
         interpret=_auto_interpret(interpret),
     )(x, g)
     return out[:b, 0]
+
+
+# --------------------------------------------------------------------------
+# Fused eval-mode BatchNorm grad-norm² kernel (stackable across layers).
+# --------------------------------------------------------------------------
+#
+# The XLA form of the BN contribution (`grand_batched._bn_contrib`) is two
+# f32 multiply+reduce passes per layer; profiled on-chip they run far below
+# bandwidth (layout-hostile reductions) and each BN layer is its own fusion.
+# This kernel computes ``Σ_c ((Σ_s g·x − μ·Σ_s g)·rstd)² [+ Σ_c (Σ_s g)²]``
+# in ONE VMEM pass, and several same-shape layers can be STACKED along the
+# leading axis (their per-layer (μ, rstd) rows are indexed by segment) — one
+# kernel launch for e.g. all five [B, 8, 8, 256] BatchNorms of a ResNet-18.
+
+_BN_VMEM_BUDGET = 10 << 20
+
+
+def _bn_tile(h, w, c, itemsize) -> int:
+    lane = 128
+    cpad = -(-c // lane) * lane
+    per_ex = 2 * h * w * cpad * itemsize          # x + g blocks
+    for tile in (128, 64, 32, 16, 8):
+        if 2 * tile * per_ex <= _BN_VMEM_BUDGET:  # ×2 double-buffer margin
+            return tile
+    return 0
+
+
+def _bn_kernel(use_scale, use_bias, x_ref, g_ref, stats_ref, out_ref):
+    x = x_ref[...]
+    g = g_ref[...]
+    tb, c = x.shape[0], x.shape[-1]
+    xf = x.reshape(tb, -1, c).astype(jnp.float32)
+    gf = g.reshape(tb, -1, c).astype(jnp.float32)
+    gx = jnp.sum(gf * xf, axis=1)                 # [TB, C]
+    gs = jnp.sum(gf, axis=1)
+    mean = stats_ref[0, 0:1, :]
+    rstd = stats_ref[0, 1:2, :]
+    acc = jnp.zeros((tb, 1), jnp.float32)
+    if use_scale:
+        t = (gx - mean * gs) * rstd
+        acc += jnp.sum(t * t, axis=1, keepdims=True)
+    if use_bias:
+        acc += jnp.sum(gs * gs, axis=1, keepdims=True)
+    out_ref[...] = acc
+
+
+def bn_grad_norm_fits(x_shape, itemsize: int = 2) -> bool:
+    return _bn_tile(x_shape[1], x_shape[2], x_shape[3], itemsize) > 0
+
+
+@functools.partial(jax.jit, static_argnames=("use_scale", "use_bias", "per_layer",
+                                             "interpret"))
+def bn_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, stats: jax.Array,
+                           per_layer: int, use_scale: bool = True,
+                           use_bias: bool = True,
+                           interpret: bool | None = None) -> jax.Array:
+    """[N] ⟵ eval-mode BatchNorm per-example grad-norm², fused.
+
+    ``x``/``g`` are [N, H, W, C] with ``N = n_layers · per_layer`` (same-shape
+    layers stacked along the batch); ``stats`` is [n_layers, 8, C] — rows 0/1
+    of each layer's slab hold (mean, rstd), rows 2-7 are sublane padding
+    (Mosaic block shapes need 8-divisible second-minor dims). ``per_layer``
+    must be a multiple of the batch tile so a grid step never straddles two
+    layers' statistics.
+    """
+    n, h, w, c = x.shape
+    tile = _bn_tile(h, w, c, x.dtype.itemsize)
+    assert tile > 0, "caller must check bn_grad_norm_fits first"
+    while per_layer % tile:
+        tile //= 2
+    assert tile >= 8 and n % tile == 0, (n, per_layer, tile)
+    steps_per_layer = per_layer // tile
+    out = pl.pallas_call(
+        functools.partial(_bn_kernel, use_scale, use_bias),
+        grid=(n // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, h, w, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, h, w, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, c), lambda i: (i // steps_per_layer, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        interpret=_auto_interpret(interpret),
+    )(x, g, stats)
+    return out[:, 0]
 
 
 def _gll_kernel(feats_ref, w_ref, b_ref, labels_ref, mask_ref, out_ref):

@@ -203,3 +203,72 @@ class TestConvGradNorm:
         ref = make_grand_step(model, chunk=4)(variables, batch)
         np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
                                    rtol=2e-4, atol=1e-5)
+
+
+class TestCatDotKernel:
+    """Cross-product cat-dot conv kernel (128-aligned deep-contraction layers)."""
+
+    def test_catdot_fires_and_matches_xla(self):
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            _catdot_ok, conv_grad_norm_sq_pallas)
+        rng = np.random.default_rng(3)
+        h, c, k = 16, 128, 128
+        ks, st, pad = (3, 3), (1, 1), ((1, 1), (1, 1))
+        x = jnp.asarray(rng.normal(size=(10, h, h, c)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(10, h, h, k)).astype(np.float32))
+        assert _catdot_ok(h + 2, h + 2, c, h, h, k, *ks, x.dtype.itemsize)
+        got = conv_grad_norm_sq_pallas(x, g, ks, st, pad, interpret=True,
+                                       catdot=True)
+        ref = TestConvGradNorm._ref(None, x, g, ks, st, pad)
+        np.testing.assert_allclose(np.asarray(got) / 1e3, np.asarray(ref) / 1e3,
+                                   rtol=1e-5, atol=1e-4)
+        # And the two kernels agree with each other on the same inputs.
+        per_offset = conv_grad_norm_sq_pallas(x, g, ks, st, pad,
+                                              interpret=True, catdot=False)
+        np.testing.assert_allclose(np.asarray(got) / 1e3,
+                                   np.asarray(per_offset) / 1e3, rtol=1e-5)
+
+    def test_catdot_gates(self):
+        from data_diet_distributed_tpu.ops.pallas_kernels import _catdot_ok
+        assert not _catdot_ok(34, 34, 64, 32, 32, 64, 3, 3, 2)    # c % 128
+        assert not _catdot_ok(6, 6, 128, 4, 4, 128, 3, 3, 2)      # short S
+        assert not _catdot_ok(18, 18, 128, 16, 16, 128, 1, 1, 2)  # 1x1 conv
+
+
+class TestBatchNormKernel:
+    """Fused stacked BatchNorm grad-norm kernel vs the XLA reduction form."""
+
+    @pytest.mark.parametrize("layers,use_scale,use_bias", [
+        (1, True, True), (3, True, True), (2, True, False), (2, False, True),
+    ])
+    def test_stacked_bn_matches_reference(self, layers, use_scale, use_bias):
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            bn_grad_norm_fits, bn_grad_norm_sq_pallas)
+        rng = np.random.default_rng(4)
+        bl, hw, ch = 16, 6, 32
+        x = jnp.asarray(rng.normal(size=(layers * bl, hw, hw, ch))
+                        .astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(layers * bl, hw, hw, ch))
+                        .astype(np.float32))
+        means = rng.normal(size=(layers, ch)).astype(np.float32)
+        rstds = (np.abs(rng.normal(size=(layers, ch))) + 0.5).astype(np.float32)
+        stats = jnp.asarray(np.pad(np.stack(
+            [np.stack([means[i], rstds[i]]) for i in range(layers)]),
+            ((0, 0), (0, 6), (0, 0))))
+        assert bn_grad_norm_fits(x.shape, x.dtype.itemsize)
+        got = bn_grad_norm_sq_pallas(x, g, stats, bl, use_scale=use_scale,
+                                     use_bias=use_bias, interpret=True)
+        refs = []
+        for i in range(layers):
+            xs = np.asarray(x[i * bl:(i + 1) * bl]).reshape(bl, -1, ch)
+            gs = np.asarray(g[i * bl:(i + 1) * bl]).reshape(bl, -1, ch)
+            gx = (gs * xs).sum(1)
+            gsum = gs.sum(1)
+            r = np.zeros(bl, np.float32)
+            if use_scale:
+                r += (((gx - means[i] * gsum) * rstds[i]) ** 2).sum(-1)
+            if use_bias:
+                r += (gsum * gsum).sum(-1)
+            refs.append(r)
+        np.testing.assert_allclose(np.asarray(got), np.concatenate(refs),
+                                   rtol=1e-4, atol=1e-4)

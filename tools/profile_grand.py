@@ -1,0 +1,177 @@
+"""Per-component wall-clock profile of the batched-GraNd scoring pass.
+
+The host↔device relay on this setup has ~25 ms per-dispatch latency, so naive
+per-op timing measures only dispatch. Every component here is therefore timed
+ON-DEVICE: the op runs inside a ``fori_loop`` whose body depends on the carry
+(no CSE), with a dynamic trip count — cost per iteration is the difference
+quotient between a long and a short run, which cancels dispatch+fetch overhead.
+
+Run: python tools/profile_grand.py [--batch 1024] [--arch resnet18]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.ops import grand_batched as gb
+
+N_LONG, N_SHORT = 9, 1
+
+
+def per_iter_seconds(fn, *args):
+    """fn(n, *args) -> scalar, running the payload n times on device."""
+    fn(N_SHORT, *args).block_until_ready()          # compile
+    float(fn(N_SHORT, *args))                        # sync via fetch
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(fn(n, *args))                          # fetch = real barrier
+        return time.perf_counter() - t0
+    t_short, t_long = run(N_SHORT), run(N_LONG)
+    t_short, t_long = min(t_short, run(N_SHORT)), min(t_long, run(N_LONG))
+    return (t_long - t_short) / (N_LONG - N_SHORT)
+
+
+def repeated(payload):
+    """jit fn(n, *args): run payload n times with a carry dependency."""
+    @partial(jax.jit, static_argnums=())
+    def fn(n, *args):
+        def body(_, acc):
+            eps = acc * jnp.float32(1e-30)           # ~0 but data-dependent
+            out = payload(*[a + eps.astype(a.dtype) if a.dtype != jnp.int32
+                            else a for a in args])
+            return acc + jnp.sum(out.astype(jnp.float32))
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+    return fn
+
+
+def conv_flops(rec, x_shape, g_shape):
+    s = int(np.prod(g_shape[1:-1]))
+    f = int(np.prod(rec["kernel_size"])) * x_shape[-1]
+    k = g_shape[-1]
+    direct = s * f * k
+    gram = s * s * (f + k)
+    return 2.0 * min(direct, gram), gram < direct
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--no-pallas", action="store_true")
+    args = ap.parse_args()
+    use_pallas = not args.no_pallas
+
+    model = create_model(args.arch, args.classes, half_precision=True)
+    rng = jax.random.key(0)
+    img = jax.random.normal(rng, (args.batch, args.size, args.size, 3),
+                            jnp.float32)
+    label = jax.random.randint(rng, (args.batch,), 0, args.classes)
+    mask = jnp.ones((args.batch,), jnp.float32)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        rng, img[:1], train=False)
+
+    from data_diet_distributed_tpu.ops.scores import cross_entropy
+    import flax.linen as nn
+
+    records: list[dict] = []
+    cap_int = gb._make_interceptor(records)
+    run_int = gb._make_interceptor(None)
+
+    def loss_fn(perts, i):
+        with nn.intercept_methods(run_int):
+            logits, mut = model.apply({**variables, "ddt_pert": perts}, i,
+                                      train=False, mutable=["ddt_in"])
+        return jnp.sum(cross_entropy(logits, label) * mask), mut["ddt_in"]
+
+    def init_shapes(i):
+        with nn.intercept_methods(cap_int):
+            _, mut = model.apply(variables, i, train=False,
+                                 mutable=["ddt_pert", "ddt_in"])
+        return mut["ddt_pert"]
+
+    pert_shapes = jax.eval_shape(init_shapes, img)
+
+    def fwdbwd(i):
+        perts0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                              pert_shapes)
+        c, _ = jax.grad(loss_fn, has_aux=True)(perts0, i)
+        return sum(jnp.sum(l.astype(jnp.float32)) for l in jax.tree.leaves(c))
+
+    t_fb = per_iter_seconds(repeated(fwdbwd), img)
+    print(f"fwd+bwd (cotangents only): {t_fb*1e3:8.2f} ms   "
+          f"{args.batch/t_fb:9.0f} ex/s", flush=True)
+
+    def full(i):
+        return gb.batched_grand_scores(model, variables, i, label, mask,
+                                       use_pallas=use_pallas)
+    t_full = per_iter_seconds(repeated(full), img)
+    print(f"full batched GraNd pass  : {t_full*1e3:8.2f} ms   "
+          f"{args.batch/t_full:9.0f} ex/s   contraction share "
+          f"{(t_full-t_fb)*1e3:.2f} ms", flush=True)
+
+    # Real captured tensors for per-geometry timing.
+    perts0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), pert_shapes)
+    cots, caps = jax.jit(jax.grad(loss_fn, has_aux=True))(perts0, img)
+
+    batch_stats = variables.get("batch_stats", {})
+    groups: dict[tuple, dict] = {}
+    for rec in records:
+        x = gb._leaf(caps, rec["path"], "x")
+        g = gb._leaf(cots, rec["path"], "y")
+        key = (rec["kind"], x.shape, g.shape,
+               rec.get("kernel_size"), rec.get("strides"))
+        grp = groups.setdefault(key, {"rec": rec, "x": x, "g": g, "count": 0,
+                                      "name": "/".join(rec["path"])})
+        grp["count"] += 1
+
+    rows = []
+    for (kind, xs, gs, _, _), grp in groups.items():
+        rec, x, g, count = grp["rec"], grp["x"], grp["g"], grp["count"]
+        if kind == "conv":
+            t = per_iter_seconds(repeated(
+                partial(gb._conv_contrib, rec, use_pallas=use_pallas)), x, g)
+            fl, is_gram = conv_flops(rec, x.shape, g.shape)
+            rows.append((t * count, count, grp["name"], kind,
+                         f"x{tuple(x.shape[1:])} g{tuple(g.shape[1:])}"
+                         f" k{rec['kernel_size']} s{rec['strides']}",
+                         f"{fl*args.batch/t/1e12:6.1f} TF/s"
+                         f"{' gram' if is_gram else ''}"))
+        elif kind == "dense":
+            t = per_iter_seconds(repeated(partial(gb._dense_contrib, rec)),
+                                 x, g)
+            rows.append((t * count, count, grp["name"], kind,
+                         f"x{tuple(x.shape[1:])} g{tuple(g.shape[1:])}", ""))
+        else:
+            t = per_iter_seconds(repeated(
+                partial(gb._bn_contrib, rec, batch_stats=batch_stats)), x, g)
+            rows.append((t * count, count, grp["name"], kind,
+                         f"x{tuple(x.shape[1:])}", ""))
+        r = rows[-1]
+        print(f"{r[0]*1e3:8.2f} ms  n={r[1]}  {r[3]:<5} {r[2]:<32} "
+              f"{r[4]} {r[5]}", flush=True)
+
+    rows.sort(reverse=True)
+    tot = sum(r[0] for r in rows)
+    print(f"\n== sorted ==\n{'ms(tot)':>8} {'n':>2} {'cum%':>5}  {'kind':<5} "
+          f"{'example layer':<32} shapes / TF/s")
+    cum = 0.0
+    for t, count, name, kind, shapes, tfs in rows:
+        cum += t
+        print(f"{t*1e3:8.2f} {count:>2} {100*cum/tot:4.0f}%  {kind:<5} "
+              f"{name:<32} {shapes} {tfs}")
+    print(f"\nsum of isolated contractions: {tot*1e3:.2f} ms "
+          f"(full-pass contraction share {(t_full-t_fb)*1e3:.2f} ms)")
+
+
+if __name__ == "__main__":
+    main()
