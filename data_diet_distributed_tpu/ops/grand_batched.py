@@ -63,7 +63,9 @@ import os as _os
 
 def _toggle(name: str, default: bool) -> bool:
     v = _os.environ.get(name)
-    return default if v is None else v not in ("0", "false", "False")
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
 
 
 GROUP_CONV = _toggle("DDT_GRAND_GROUP_CONV", False)

@@ -108,7 +108,13 @@ def el2n_pallas(logits: jax.Array, labels: jax.Array, mask: jax.Array,
 # Fused conv weight-grad-norm kernel (the batched-GraNd hot loop).
 # --------------------------------------------------------------------------
 
-_CONV_VMEM_BUDGET = 10 << 20   # bytes per grid step; v5e VMEM is ~16 MiB
+# Per-grid-step working-set budget for the BlockSpec conv kernels. The 16 MiB
+# scoped-VMEM default is a COMPILER knob (v5e compiles and runs these kernels
+# with far higher limits — verified on-chip); wide-channel layers (WideResNet's
+# 160/320-channel stages, ResNet-50 bottlenecks) need more than the default,
+# so calls whose plan exceeds 16 MiB raise the limit via compiler_params.
+_CONV_VMEM_BUDGET = 40 << 20
+_SCOPED_VMEM_DEFAULT = 16 << 20
 
 
 def _conv_norm_kernel(kh, kw, x_ref, g_ref, out_ref):
@@ -170,17 +176,23 @@ def _conv_norm_catdot_kernel(kh, kw, x_ref, g_ref, out_ref):
     out_ref[...] = jnp.sum(jnp.sum(m * m, axis=2), axis=1, keepdims=True)
 
 
+def _conv_need_bytes(hp, wp, c, ho, wo, k, itemsize, tile: int = 8) -> int:
+    """Estimated per-grid-step VMEM bytes for the BlockSpec conv kernels."""
+    lane = 128
+    cpad, kpad = -(-c // lane) * lane, -(-k // lane) * lane
+    per_ex = (hp * wp * cpad + ho * wo * kpad) * itemsize + cpad * kpad * 4
+    return 2 * tile * per_ex                         # ×2: double-buffer margin
+
+
 def _conv_tile_b(hp, wp, c, ho, wo, k, itemsize) -> int:
     """Largest batch tile whose working set fits the VMEM budget (0 = none).
 
     Tiles below 8 are NOT offered: the output block is ``(tile, 1)`` and
     Mosaic requires its sublane dim divisible by 8 — a tile of 4 compiles in
     interpret mode but crashes the hardware lowering."""
-    lane = 128
-    cpad, kpad = -(-c // lane) * lane, -(-k // lane) * lane
-    per_ex = (hp * wp * cpad + ho * wo * kpad) * itemsize + cpad * kpad * 4
     for tile in (8,):
-        if 2 * tile * per_ex <= _CONV_VMEM_BUDGET:   # ×2: double-buffer margin
+        if _conv_need_bytes(hp, wp, c, ho, wo, k, itemsize,
+                            tile) <= _CONV_VMEM_BUDGET:
             return tile
     return 0
 
@@ -240,7 +252,13 @@ def _unit_stride_norm_sq(x_pad, g, kh, kw, interpret, catdot=False):
         params = pltpu.CompilerParams(vmem_limit_bytes=_CATDOT_VMEM_CAP)
     else:
         kernel = functools.partial(_conv_norm_kernel, kh, kw)
-        params = None
+        # Wide-channel layers (WRN 160/320, R50 bottlenecks) exceed the
+        # 16 MiB scoped-VMEM default — raise the compiler limit for them.
+        need = _conv_need_bytes(hp, wp, c, ho, wo, k, x_pad.dtype.itemsize,
+                                tile)
+        params = (pltpu.CompilerParams(
+                      vmem_limit_bytes=min(2 * need, _CATDOT_VMEM_CAP))
+                  if need > _SCOPED_VMEM_DEFAULT // 2 else None)
     out = pl.pallas_call(
         kernel,
         grid=(b_pad // tile,),

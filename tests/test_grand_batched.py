@@ -198,3 +198,35 @@ def test_imagenet_stem_matches_vmap():
     ref = make_grand_step(model, chunk=2)(variables, batch)
     np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_grouped_dispatch_matches_ungrouped(monkeypatch):
+    """Same-geometry layer grouping (GROUP_CONV/GROUP_BN/USE_BN_KERNEL) is a
+    launch-count optimization only: scores must match the ungrouped per-layer
+    dispatch bit-for-bit-close on every toggle combination."""
+    from data_diet_distributed_tpu.ops import grand_batched as gb
+    from data_diet_distributed_tpu.ops.grand_batched import batched_grand_scores
+
+    model = create_model("resnet18", 10)
+    batch = _batch(6, 32, seed=7)
+    variables = _trained_stats(model, _init(model, 32), batch)
+
+    def run(**flags):
+        for k, v in flags.items():
+            monkeypatch.setattr(gb, k, v)
+        return np.asarray(jax.jit(lambda v, b: batched_grand_scores(
+            model, v, b["image"], b["label"], b["mask"], use_pallas=True))(
+                variables, batch))
+
+    base = run(GROUP_CONV=False, GROUP_BN=False, USE_BN_KERNEL=False,
+               USE_CATDOT=False)
+    for flags in (dict(GROUP_CONV=True),
+                  dict(GROUP_BN=True, USE_BN_KERNEL=True),
+                  dict(GROUP_CONV=True, GROUP_BN=True, USE_BN_KERNEL=True,
+                       USE_CATDOT=True)):
+        full = dict(GROUP_CONV=False, GROUP_BN=False, USE_BN_KERNEL=False,
+                    USE_CATDOT=False)
+        full.update(flags)
+        got = run(**full)
+        np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6,
+                                   err_msg=str(flags))

@@ -1,77 +1,52 @@
-"""Bisect the batched-GraNd composition toggles on-chip: times the FULL pass
-under each toggle combination with on-device repetition (see profile_grand)."""
+"""Bisect the batched-GraNd composition toggles on-chip.
+
+Runs ``bench.py`` once per toggle combination (the DDT_GRAND_* env vars are
+read by ``ops/grand_batched`` at import) and prints one result line each.
+This measures the REAL production pass — the same program the driver's bench
+runs — rather than a rewrapped loop, because full-pass compiles through the
+relay are slow enough that per-combination jit variants are impractical.
+
+Run: python tools/bisect_grand.py [--size N] [--batch B]
+"""
 
 from __future__ import annotations
 
 import argparse
-import time
+import os
+import subprocess
+import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from data_diet_distributed_tpu.models import create_model
-from data_diet_distributed_tpu.ops import grand_batched as gb
-
-N_LONG, N_SHORT = 9, 1
-
-
-def per_iter(f, *args):
-    float(f(N_SHORT, *args))
-
-    def run(n):
-        t0 = time.perf_counter()
-        float(f(n, *args))
-        return time.perf_counter() - t0
-    ts = min(run(N_SHORT), run(N_SHORT))
-    tl = min(run(N_LONG), run(N_LONG))
-    return (tl - ts) / (N_LONG - N_SHORT)
+COMBOS = [
+    ("baseline", {}),
+    ("catdot", {"DDT_GRAND_CATDOT": "1"}),
+    ("bn_kernel", {"DDT_GRAND_BN_KERNEL": "1"}),
+    ("bn_kernel+group_bn", {"DDT_GRAND_BN_KERNEL": "1",
+                            "DDT_GRAND_GROUP_BN": "1"}),
+    ("group_conv", {"DDT_GRAND_GROUP_CONV": "1"}),
+]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=1024)
-    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--timeout", type=int, default=900)
     args = ap.parse_args()
-
-    model = create_model(args.arch, 10, half_precision=True)
-    rng = jax.random.key(0)
-    img = jax.random.normal(rng, (args.batch, 32, 32, 3), jnp.float32)
-    label = jax.random.randint(rng, (args.batch,), 0, 10)
-    mask = jnp.ones((args.batch,), jnp.float32)
-    variables = jax.jit(model.init, static_argnames=("train",))(
-        rng, img[:1], train=False)
-
-    combos = [
-        ("all-off           ", dict(GROUP_CONV=False, GROUP_BN=False,
-                                    USE_BN_KERNEL=False, USE_CATDOT=False)),
-        ("+catdot           ", dict(GROUP_CONV=False, GROUP_BN=False,
-                                    USE_BN_KERNEL=False, USE_CATDOT=True)),
-        ("+group_conv       ", dict(GROUP_CONV=True, GROUP_BN=False,
-                                    USE_BN_KERNEL=False, USE_CATDOT=False)),
-        ("+bn_kernel        ", dict(GROUP_CONV=False, GROUP_BN=False,
-                                    USE_BN_KERNEL=True, USE_CATDOT=False)),
-        ("+bn_kernel+group  ", dict(GROUP_CONV=False, GROUP_BN=True,
-                                    USE_BN_KERNEL=True, USE_CATDOT=False)),
-        ("all-on            ", dict(GROUP_CONV=True, GROUP_BN=True,
-                                    USE_BN_KERNEL=True, USE_CATDOT=True)),
-    ]
-    for name, flags in combos:
-        for k, v in flags.items():
-            setattr(gb, k, v)
-
-        @jax.jit
-        def full(n, i):
-            def body(_, acc):
-                eps = (acc * jnp.float32(1e-30)).astype(i.dtype)
-                s = gb.batched_grand_scores(model, variables, i + eps, label,
-                                            mask, use_pallas=True)
-                return acc + jnp.sum(s)
-            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
-
-        t = per_iter(full, img)
-        print(f"{name}: {t*1e3:7.2f} ms   {args.batch/t:8.0f} ex/s",
-              flush=True)
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    for name, env in COMBOS:
+        cmd = [sys.executable, bench, "--size", str(args.size),
+               "--batch", str(args.batch)]
+        try:
+            out = subprocess.run(
+                cmd, env={**os.environ, **env}, capture_output=True,
+                text=True, timeout=args.timeout)
+            lines = [ln for ln in out.stdout.splitlines()
+                     if ln.startswith("{")]
+            print(f"{name:20s}: {lines[-1] if lines else out.stderr[-200:]}",
+                  flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{name:20s}: TIMEOUT", flush=True)
 
 
 if __name__ == "__main__":
