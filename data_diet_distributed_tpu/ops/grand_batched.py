@@ -72,6 +72,7 @@ GROUP_CONV = _toggle("DDT_GRAND_GROUP_CONV", False)
 GROUP_BN = _toggle("DDT_GRAND_GROUP_BN", False)
 USE_BN_KERNEL = _toggle("DDT_GRAND_BN_KERNEL", False)
 USE_CATDOT = _toggle("DDT_GRAND_CATDOT", False)
+STEM_XLA = _toggle("DDT_GRAND_STEM_XLA", False)  # tiny-F convs via XLA patches
 
 
 def _canon_tuple(v, n: int) -> tuple:
@@ -220,6 +221,10 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
     # Kernel-eligible iff direct FLOPs are within the ratio of Gram's (the
     # not-gram case satisfies this by definition: f*k <= s*(f+k)).
     direct_ok = f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
+    if STEM_XLA and f < 32:
+        # Tiny-F layers (the 3-channel stem) under-fill every MXU form; let
+        # XLA's fused patch einsum take them (bisection toggle).
+        use_pallas = False
     if use_pallas:
         from .pallas_kernels import (_catdot_ok, conv_grad_norm_gram_eligible,
                                      conv_grad_norm_pallas_fits,

@@ -222,10 +222,11 @@ def test_grouped_dispatch_matches_ungrouped(monkeypatch):
                USE_CATDOT=False)
     for flags in (dict(GROUP_CONV=True),
                   dict(GROUP_BN=True, USE_BN_KERNEL=True),
+                  dict(STEM_XLA=True),
                   dict(GROUP_CONV=True, GROUP_BN=True, USE_BN_KERNEL=True,
                        USE_CATDOT=True)):
         full = dict(GROUP_CONV=False, GROUP_BN=False, USE_BN_KERNEL=False,
-                    USE_CATDOT=False)
+                    USE_CATDOT=False, STEM_XLA=False)
         full.update(flags)
         got = run(**full)
         np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6,

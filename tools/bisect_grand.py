@@ -23,6 +23,7 @@ COMBOS = [
     ("bn_kernel+group_bn", {"DDT_GRAND_BN_KERNEL": "1",
                             "DDT_GRAND_GROUP_BN": "1"}),
     ("group_conv", {"DDT_GRAND_GROUP_CONV": "1"}),
+    ("stem_xla", {"DDT_GRAND_STEM_XLA": "1"}),
 ]
 
 
