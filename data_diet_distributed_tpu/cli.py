@@ -81,23 +81,13 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
                           checkpoint_dir=cfg.train.checkpoint_dir, tag="dense")
     elif command == "score":
         from .data.pipeline import BatchSharder
-        from .models import create_model
-        from .ops.scoring import score_dataset
         from .parallel.mesh import is_primary, make_mesh
-        from .train.loop import load_data_for, score_variables_for_seeds
+        from .train.loop import compute_scores, load_data_for
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
         train_ds, _ = load_data_for(cfg)
-        seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
-                                               sharder=sharder, logger=logger)
-        model = create_model(cfg.model.arch, cfg.model.num_classes,
-                             cfg.train.half_precision, stem=cfg.model.stem)
-        scores = score_dataset(model, seeds_vars, train_ds,
-                               method=cfg.score.method,
-                               batch_size=cfg.score.batch_size,
-                               sharder=sharder, chunk=cfg.score.grand_chunk,
-                               eval_mode=cfg.score.eval_mode,
-                               use_pallas=cfg.score.use_pallas)
+        scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                                logger=logger)
         out = f"{cfg.train.checkpoint_dir}_scores.npz"
         if is_primary():   # every process holds the full scores; one writes
             np.savez(out, scores=scores, indices=train_ds.indices)

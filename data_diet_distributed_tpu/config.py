@@ -66,9 +66,11 @@ class OptimConfig:
 class ScoreConfig:
     """Per-example scoring pass (reference: ``get_scores_and_prune.py``)."""
 
-    # el2n | grand | grand_vmap | grand_last_layer. "grand" is full-parameter
-    # GraNd via the batched exact algorithm (ops/grand_batched.py) in eval mode;
-    # "grand_vmap" forces the naive vmap(grad) path (cross-checks, exotic layers).
+    # el2n | grand | grand_vmap | grand_last_layer | forgetting. "grand" is
+    # full-parameter GraNd via the batched exact algorithm (ops/grand_batched.py)
+    # in eval mode; "grand_vmap" forces the naive vmap(grad) path (cross-checks,
+    # exotic layers); "forgetting" counts forgetting events across
+    # score.pretrain_epochs of training (Toneva et al. 2019, ops/forgetting.py).
     method: str = "el2n"
     # Which checkpoint feeds the scoring pass. The reference hard-codes epoch 19
     # (train.py:61, ddp.py:72); here it is a knob.
@@ -169,8 +171,15 @@ class Config:
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
         if self.score.method not in ("el2n", "grand", "grand_vmap",
-                                     "grand_last_layer"):
+                                     "grand_last_layer", "forgetting"):
             raise ValueError(f"unknown score method {self.score.method!r}")
+        if self.score.method == "forgetting" and self.score.pretrain_epochs < 1:
+            raise ValueError("score.method=forgetting tracks correctness across "
+                             "training epochs; set score.pretrain_epochs >= 1")
+        if self.score.method == "forgetting" and self.score.score_ckpt_step is not None:
+            raise ValueError(
+                "score.method=forgetting scores a training TRAJECTORY and "
+                "cannot start from score.score_ckpt_step; unset one of them")
         if self.model.stem not in ("cifar", "imagenet"):
             raise ValueError(f"unknown stem {self.model.stem!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):

@@ -1,0 +1,54 @@
+"""Forgetting-events score (Toneva et al. 2019, "An Empirical Study of Example
+Forgetting during Deep Neural Network Learning").
+
+A forgetting event for example ``i`` is a transition from classified-correctly
+at one observation to misclassified at the next; examples with FEW events
+("unforgettable") are the ones that can be dropped with least damage, so the
+event count works directly as a keep-hardest pruning score. Examples that are
+never learned rank strictly hardest (the paper treats them as forgotten
+infinitely often).
+
+The reference implements EL2N only (``get_scores_and_prune.py:15-18``); the
+Data Diet paper uses forgetting scores as its main prior-work comparison, which
+makes this the natural third scoring method for the framework. The accumulation
+is host-side numpy over one ``[N]`` correctness vector per epoch — the device
+work is the sharded correctness pass (``ops/scores.make_correctness_step``),
+and N is dataset-sized (50k for CIFAR), so the host arithmetic is free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ForgettingTracker:
+    """Accumulates forgetting events from one correctness vector per epoch.
+
+    ``update`` is called once per observation (epoch) with ``correct[N]`` in
+    dataset row order; ``scores`` returns the per-example event counts with
+    never-learned examples pinned above every possible count.
+    """
+
+    def __init__(self, n: int):
+        self.counts = np.zeros(n, np.int64)
+        self.prev = np.zeros(n, bool)
+        self.learned = np.zeros(n, bool)
+        self.updates = 0
+
+    def update(self, correct: np.ndarray) -> None:
+        correct = np.asarray(correct, dtype=bool)
+        if correct.shape != self.prev.shape:
+            raise ValueError(
+                f"correctness vector has shape {correct.shape}, expected "
+                f"{self.prev.shape}")
+        self.counts += self.prev & ~correct
+        self.learned |= correct
+        self.prev = correct
+        self.updates += 1
+
+    def scores(self) -> np.ndarray:
+        """[N] float32 — event counts; never-learned = ``updates + 1`` (strictly
+        above any achievable count, so keep-hardest retains them first)."""
+        out = self.counts.astype(np.float32)
+        out[~self.learned] = float(self.updates + 1)
+        return out

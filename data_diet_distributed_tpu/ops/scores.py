@@ -130,6 +130,20 @@ def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
 
 
 @functools.cache
+def make_correctness_step(model, mesh: Mesh | None = None,
+                          eval_mode: bool = True):
+    """Per-example 0/1 correctness [B] over a (possibly mesh-sharded) batch —
+    the per-epoch signal the forgetting-events score accumulates
+    (``ops/forgetting.ForgettingTracker``). Padded rows report 0."""
+
+    def local_scores(variables, image, label, mask):
+        logits = _forward(model, variables, image, eval_mode=eval_mode)
+        return (jnp.argmax(logits, -1) == label).astype(jnp.float32) * mask
+
+    return _wrap(local_scores, mesh)
+
+
+@functools.cache
 def make_grand_last_layer_step(model, mesh: Mesh | None = None,
                                eval_mode: bool = True,
                                use_pallas: bool | None = None):

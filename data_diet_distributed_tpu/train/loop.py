@@ -120,8 +120,12 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         logger: MetricsLogger | None = None, num_epochs: int | None = None,
         seed: int | None = None, checkpoint_dir: str | None = None,
         resume_step: int | None = None, saved_steps: list[int] | None = None,
-        tag: str = "train", train_resident=None) -> FitResult:
-    """Train a fresh model (or resume) for exactly ``num_epochs`` epochs."""
+        tag: str = "train", train_resident=None, epoch_hook=None) -> FitResult:
+    """Train a fresh model (or resume) for exactly ``num_epochs`` epochs.
+
+    ``epoch_hook(model, state, epoch)``, when given, runs after each epoch's
+    eval — the attachment point for cross-epoch observers such as the
+    forgetting-events tracker (``forgetting_scores``)."""
     cfg = _with_epochs(cfg, num_epochs, seed)
     mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
     sharder = sharder or BatchSharder(mesh)
@@ -199,7 +203,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
         _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                     sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                    saved_steps, train_resident, test_resident, steps_per_epoch)
+                    saved_steps, train_resident, test_resident, steps_per_epoch,
+                    epoch_hook)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -210,7 +215,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
                 saved_steps=None, train_resident=None, test_resident=None,
-                steps_per_epoch=None):
+                steps_per_epoch=None, epoch_hook=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         shuffle = cfg.data.shuffle_each_epoch
@@ -253,6 +258,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                           eval_step, resident=test_resident)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
+        if epoch_hook is not None:
+            epoch_hook(model, state, epoch)
         logger.log("epoch", tag=tag, **record)
         result.history.append(record)
         if ckpt is not None and ((epoch + 1) % cfg.train.checkpoint_every == 0
@@ -382,6 +389,96 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     return out
 
 
+def forgetting_scores(cfg: Config, train_ds: ArrayDataset, *,
+                      mesh, sharder, logger) -> np.ndarray:
+    """Forgetting-events scores (Toneva et al. 2019; ``ops/forgetting.py``).
+
+    Per seed: train a fresh model for ``score.pretrain_epochs`` epochs and,
+    after each epoch, run a mesh-sharded correctness pass over the train set in
+    dataset order (reusing the training's device-resident upload when present);
+    the tracker counts correct→incorrect transitions on the host. Scores are
+    the per-seed mean. Unlike EL2N/GraNd this score is a property of a training
+    TRAJECTORY, not of one checkpoint — hence the fit-with-hook structure
+    instead of ``score_dataset``.
+    """
+    if cfg.score.pretrain_epochs < 1:
+        raise ValueError(
+            "score.method=forgetting tracks correctness across training "
+            "epochs; set score.pretrain_epochs >= 1")
+    from ..ops.scores import make_correctness_step
+    from ..ops.forgetting import ForgettingTracker
+    from ..ops.scoring import _to_host
+
+    model = create_model(cfg.model.arch, cfg.model.num_classes,
+                         cfg.train.half_precision, stem=cfg.model.stem)
+    # Plain jit (mesh=None -> no shard_map), like eval_step: the hook feeds
+    # TRAINING-layout batches (data-axis sharded, train batch size) and
+    # TP-placed state.variables, and sharding propagation partitions the
+    # forward exactly as train/eval do. The flattened-mesh shard_map layout
+    # belongs to score_dataset's re-sharded pipeline, not to this hook.
+    step = make_correctness_step(model, None, eval_mode=cfg.score.eval_mode)
+    n = len(train_ds)
+    batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
+    shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
+    total = np.zeros(n, np.float64)
+    for s in cfg.score.seeds:
+        tracker = ForgettingTracker(n)
+
+        def hook(model_, state, epoch, tracker=tracker):
+            batches = (shared_resident(shuffle=False)
+                       if shared_resident is not None else
+                       (sharder(hb) for hb in iterate_batches(
+                           train_ds, batch_size, shuffle=False)))
+            # Bounded dispatch window in streaming mode so queued uploads
+            # can't pin every batch in HBM (same pattern as evaluate /
+            # score_dataset); resident batches live on device -> one flush.
+            window = 1 << 30 if shared_resident is not None else 8
+            chunks: list[np.ndarray] = []
+            pending: list = []
+
+            def flush():
+                chunks.extend(np.asarray(a) for a in _to_host(pending))
+                pending.clear()
+
+            for b in batches:
+                pending.append(step(state.variables, b))
+                if len(pending) >= window:
+                    flush()
+            flush()
+            tracker.update(np.concatenate(chunks)[:n] > 0.5)
+
+        fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
+            num_epochs=cfg.score.pretrain_epochs, seed=int(s),
+            tag=f"forgetting_seed{s}", train_resident=shared_resident,
+            epoch_hook=hook)
+        logger.log("forgetting_seed_done", seed=int(s),
+                   epochs=tracker.updates,
+                   never_learned=int((~tracker.learned).sum()),
+                   mean_events=float(tracker.counts.mean()))
+        total += tracker.scores()
+    return (total / len(cfg.score.seeds)).astype(np.float32)
+
+
+def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
+                   mesh, sharder, logger) -> np.ndarray:
+    """Dispatch the configured scoring method to its driver: checkpoint-based
+    scores (EL2N / GraNd family) go through ``score_dataset`` over per-seed
+    scoring models; trajectory-based forgetting scores train-and-track."""
+    if cfg.score.method == "forgetting":
+        return forgetting_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                                 logger=logger)
+    seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
+                                           sharder=sharder, logger=logger)
+    model = create_model(cfg.model.arch, cfg.model.num_classes,
+                         cfg.train.half_precision, stem=cfg.model.stem)
+    return score_dataset(model, seeds_vars, train_ds,
+                         method=cfg.score.method,
+                         batch_size=cfg.score.batch_size,
+                         sharder=sharder, chunk=cfg.score.grand_chunk,
+                         eval_mode=cfg.score.eval_mode,
+                         use_pallas=cfg.score.use_pallas)
+
+
 def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, Any]:
     """End-to-end: (pretrain →) score → prune → retrain-from-scratch → final eval."""
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
@@ -395,26 +492,21 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
     t0 = time.perf_counter()
 
     if cfg.prune.sparsity > 0.0:
-        seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
-                                               sharder=sharder, logger=logger)
-        model = create_model(cfg.model.arch, cfg.model.num_classes,
-                             cfg.train.half_precision, stem=cfg.model.stem)
         t_score = time.perf_counter()
-        scores = score_dataset(model, seeds_vars, train_ds,
-                               method=cfg.score.method,
-                               batch_size=cfg.score.batch_size,
-                               sharder=sharder, chunk=cfg.score.grand_chunk,
-                               eval_mode=cfg.score.eval_mode,
-                               use_pallas=cfg.score.use_pallas)
+        scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                                logger=logger)
         score_s = time.perf_counter() - t_score
         kept = select_indices(scores, train_ds.indices, cfg.prune.sparsity,
                               keep=cfg.prune.keep, seed=cfg.train.seed)
         if is_primary():   # every process holds the full scores; one writes
             np.savez(f"{cfg.train.checkpoint_dir}_scores.npz", scores=scores,
                      indices=train_ds.indices, kept=kept)
+        # A fixed scoring checkpoint means one pass regardless of seeds.
+        n_passes = (1 if cfg.score.score_ckpt_step is not None
+                    else len(cfg.score.seeds))
         logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
                    score_s=round(score_s, 3),
-                   score_examples_per_s=len(train_ds) * len(seeds_vars) / score_s)
+                   score_examples_per_s=len(train_ds) * n_passes / score_s)
         summary.update(n_kept=len(kept), score_wall_s=score_s)
         train_subset = train_ds.subset(kept)
     else:

@@ -1,0 +1,117 @@
+"""Forgetting-events score (ops/forgetting.py + train.loop.forgetting_scores).
+
+Not in the reference (EL2N only, ``get_scores_and_prune.py:15-18``); the score
+is the Data Diet paper's main prior-work comparison (Toneva et al. 2019).
+"""
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.data.pipeline import BatchSharder
+from data_diet_distributed_tpu.ops.forgetting import ForgettingTracker
+
+
+class TestTracker:
+    def test_hand_sequence(self):
+        t = ForgettingTracker(4)
+        # example 0: learned, never forgotten -> 0 events
+        # example 1: learned, forgotten once  -> 1 event
+        # example 2: learned/forgotten twice  -> 2 events
+        # example 3: never learned            -> updates + 1 sentinel
+        t.update(np.array([1, 1, 1, 0], bool))
+        t.update(np.array([1, 0, 0, 0], bool))
+        t.update(np.array([1, 1, 1, 0], bool))
+        t.update(np.array([1, 1, 0, 0], bool))
+        np.testing.assert_array_equal(t.scores(), [0.0, 1.0, 2.0, 5.0])
+
+    def test_never_learned_ranks_above_max_events(self):
+        t = ForgettingTracker(2)
+        for correct in ([1, 0], [0, 0], [1, 0], [0, 0]):
+            t.update(np.array(correct, bool))
+        s = t.scores()
+        assert s[1] > s[0] >= 2.0   # example 0 forgot twice; 1 never learned
+
+    def test_shape_mismatch_rejected(self):
+        t = ForgettingTracker(3)
+        with pytest.raises(ValueError, match="shape"):
+            t.update(np.ones(4, bool))
+
+
+def test_correctness_step_matches_host(mesh8):
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.ops.scores import make_correctness_step
+    import jax
+
+    model = create_model("tiny_cnn", 10)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16, 16, 3)).astype(np.float32)
+    variables = model.init(jax.random.key(0), x[:1])
+    batch = BatchSharder(mesh8)({
+        "image": x, "label": rng.integers(0, 10, 64).astype(np.int32),
+        "index": np.arange(64, dtype=np.int32),
+        "mask": np.ones(64, np.float32)})
+    got = np.asarray(make_correctness_step(model, mesh8)(variables, batch))
+    logits = model.apply(variables, x, train=False)
+    want = (np.argmax(np.asarray(logits), -1)
+            == np.asarray(batch["label"])).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    assert set(np.unique(got)) <= {0.0, 1.0}
+
+
+def test_forgetting_end_to_end(tmp_path, mesh8):
+    """run_datadiet with method=forgetting: scores land in the npz, the kept
+    set has the configured size, and retraining proceeds."""
+    from data_diet_distributed_tpu.train.loop import run_datadiet
+
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "score.method=forgetting", "score.pretrain_epochs=3",
+        "score.seeds=[0]", "train.num_epochs=1", "train.half_precision=false",
+        "prune.sparsity=0.5", f"train.checkpoint_dir={tmp_path}/ck",
+        "train.log_every_steps=1000"])
+    summary = run_datadiet(cfg)
+    assert summary["n_kept"] == 128
+    data = np.load(f"{tmp_path}/ck_scores.npz")
+    scores = data["scores"]
+    assert scores.shape == (256,)
+    # Counts are small non-negative integers (or the never-learned sentinel).
+    assert (scores >= 0).all() and (scores <= 4).all()
+    assert len(data["kept"]) == 128
+
+
+def test_forgetting_requires_pretrain_epochs():
+    with pytest.raises(ValueError, match="pretrain_epochs"):
+        load_config(None, ["score.method=forgetting",
+                           "score.pretrain_epochs=0"])
+
+
+def test_forgetting_on_tensor_parallel_mesh(tmp_path):
+    """The correctness hook runs in the TRAINING layout (plain jit, data-axis
+    batches, TP-placed variables) — a {data:4, model:2} mesh must work."""
+    from data_diet_distributed_tpu.parallel.mesh import make_mesh
+    from data_diet_distributed_tpu.train.loop import (forgetting_scores,
+                                                      load_data_for)
+    from data_diet_distributed_tpu.obs import MetricsLogger
+
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=128",
+        "data.batch_size=32", "model.arch=tiny_cnn",
+        "score.method=forgetting", "score.pretrain_epochs=2",
+        "score.seeds=[0]", "train.half_precision=false",
+        "mesh.data_axis=4", "mesh.model_axis=2",
+        "train.log_every_steps=1000"])
+    mesh = make_mesh(cfg.mesh)
+    train_ds, _ = load_data_for(cfg)
+    scores = forgetting_scores(cfg, train_ds, mesh=mesh,
+                               sharder=BatchSharder(mesh),
+                               logger=MetricsLogger(None, echo=False))
+    assert scores.shape == (128,)
+    assert (scores >= 0).all() and (scores <= 3).all()
+
+
+def test_forgetting_rejects_score_ckpt_step():
+    with pytest.raises(ValueError, match="TRAJECTORY"):
+        load_config(None, ["score.method=forgetting",
+                           "score.score_ckpt_step=100"])
