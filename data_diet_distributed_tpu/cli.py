@@ -24,10 +24,12 @@ from .obs import MetricsLogger, ResourceMonitor, plot_metrics, plot_utilization
 
 def _build(argv: list[str]) -> tuple[str, Config]:
     parser = argparse.ArgumentParser(prog="data_diet_distributed_tpu")
-    parser.add_argument("command", choices=["run", "train", "score"],
+    parser.add_argument("command", choices=["run", "train", "score", "sweep"],
                         help="run = score->prune->retrain end-to-end; "
                              "train = dense training only; "
-                             "score = compute+save per-example scores only")
+                             "score = compute+save per-example scores only; "
+                             "sweep = one scoring pass, then prune+retrain "
+                             "per prune.sweep sparsity level")
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("overrides", nargs="*", help="dotted.key=value overrides")
     args = parser.parse_args(argv)
@@ -74,6 +76,9 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
     if command == "run":
         from .train.loop import run_datadiet
         run_datadiet(cfg, logger)
+    elif command == "sweep":
+        from .train.loop import run_sweep
+        run_sweep(cfg, logger)
     elif command == "train":
         from .train.loop import fit_with_recovery, load_data_for
         train_ds, test_ds = load_data_for(cfg)

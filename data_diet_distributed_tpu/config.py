@@ -94,6 +94,11 @@ class PruneConfig:
 
     sparsity: float = 0.5      # fraction of the train set to DROP
     keep: str = "hardest"      # hardest | easiest | random (paper ablations)
+    # ``cli sweep``: retrain once per listed sparsity from ONE shared scoring
+    # pass (scores are sparsity-independent). The BASELINE WRN-28-10 sweep
+    # {0.3, 0.5, 0.7} is three reference runs, re-scoring each time; here it
+    # is one scoring pass + three retrains.
+    sweep: tuple[float, ...] = ()
 
 
 @dataclass
@@ -170,6 +175,10 @@ class Config:
             raise ValueError(f"unknown dataset {self.data.dataset!r}")
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
+        for s in self.prune.sweep:
+            if not 0.0 < s < 1.0:
+                raise ValueError(
+                    f"prune.sweep entries must be in (0, 1), got {s}")
         if self.score.method not in ("el2n", "grand", "grand_vmap",
                                      "grand_last_layer", "forgetting"):
             raise ValueError(f"unknown score method {self.score.method!r}")

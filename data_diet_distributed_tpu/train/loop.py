@@ -479,6 +479,80 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
                          use_pallas=cfg.score.use_pallas)
 
 
+def _score_passes(cfg: Config) -> int:
+    """How many dataset passes the configured scoring does (for throughput
+    logging): a fixed scoring checkpoint means one pass regardless of seeds."""
+    return 1 if cfg.score.score_ckpt_step is not None else len(cfg.score.seeds)
+
+
+def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
+                   mesh, sharder, logger, ckpt_dir: str, tag: str,
+                   score_s: float) -> dict[str, Any]:
+    """Shared prune→save-npz→retrain→summary block for one sparsity level
+    (used by ``run_datadiet`` and each ``run_sweep`` level)."""
+    kept = select_indices(scores, train_ds.indices, sparsity,
+                          keep=cfg.prune.keep, seed=cfg.train.seed)
+    if is_primary():   # every process holds the full scores; one writes
+        np.savez(f"{ckpt_dir}_scores.npz", scores=scores,
+                 indices=train_ds.indices, kept=kept)
+    logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
+               score_s=round(score_s, 3),
+               score_examples_per_s=(len(train_ds) * _score_passes(cfg)
+                                     / score_s))
+    res = fit_with_recovery(cfg, train_ds.subset(kept), test_ds, mesh=mesh,
+                            sharder=sharder, logger=logger,
+                            checkpoint_dir=ckpt_dir, tag=tag)
+    summary = {
+        "dataset": cfg.data.dataset, "n_train": len(train_ds),
+        "sparsity": float(sparsity), "score_method": cfg.score.method,
+        "n_kept": len(kept), "score_wall_s": score_s,
+        "final_test_accuracy": res.final_test_accuracy,
+        "train_wall_s": res.wall_s,
+        "total_wall_s": score_s + res.wall_s,
+    }
+    logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
+    return summary
+
+
+def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str, Any]]:
+    """Sparsity sweep from ONE scoring pass: score, then prune+retrain per level.
+
+    Scores are sparsity-independent, so the sweep pays the (pretrain +) scoring
+    cost once — the reference's equivalent (BASELINE WRN-28-10 {30,50,70}%
+    sweep) is three full runs, each redoing its scoring pass. Each level
+    retrains from scratch into its own checkpoint dir
+    (``<checkpoint_dir>_s<level>``) and reports its own summary.
+    """
+    logger = logger or MetricsLogger(cfg.obs.metrics_path)
+    sweep = cfg.prune.sweep
+    if not sweep:
+        if not 0.0 < cfg.prune.sparsity < 1.0:
+            raise ValueError("cli sweep needs prune.sweep levels (or a single "
+                             "prune.sparsity in (0, 1))")
+        sweep = (cfg.prune.sparsity,)
+    mesh = make_mesh(cfg.mesh)
+    sharder = BatchSharder(mesh)
+    train_ds, test_ds = load_data_for(cfg)
+
+    t_score = time.perf_counter()
+    scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                            logger=logger)
+    score_s = time.perf_counter() - t_score
+    logger.log("sweep_scored", n=len(train_ds), score_s=round(score_s, 3),
+               levels=list(sweep))
+
+    summaries = []
+    for sparsity in sweep:
+        # Collision-free suffix for any float level: 0.333 -> s0p333.
+        suffix = f"s{float(sparsity):g}".replace(".", "p")
+        summaries.append(_retrain_level(
+            cfg, train_ds, test_ds, scores, float(sparsity), mesh=mesh,
+            sharder=sharder, logger=logger,
+            ckpt_dir=f"{cfg.train.checkpoint_dir}_{suffix}",
+            tag=f"final_{suffix}", score_s=score_s))
+    return summaries
+
+
 def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, Any]:
     """End-to-end: (pretrain →) score → prune → retrain-from-scratch → final eval."""
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
@@ -486,39 +560,27 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
 
-    summary: dict[str, Any] = {"dataset": cfg.data.dataset, "n_train": len(train_ds),
-                               "sparsity": cfg.prune.sparsity,
-                               "score_method": cfg.score.method}
     t0 = time.perf_counter()
-
     if cfg.prune.sparsity > 0.0:
         t_score = time.perf_counter()
         scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
                                 logger=logger)
         score_s = time.perf_counter() - t_score
-        kept = select_indices(scores, train_ds.indices, cfg.prune.sparsity,
-                              keep=cfg.prune.keep, seed=cfg.train.seed)
-        if is_primary():   # every process holds the full scores; one writes
-            np.savez(f"{cfg.train.checkpoint_dir}_scores.npz", scores=scores,
-                     indices=train_ds.indices, kept=kept)
-        # A fixed scoring checkpoint means one pass regardless of seeds.
-        n_passes = (1 if cfg.score.score_ckpt_step is not None
-                    else len(cfg.score.seeds))
-        logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
-                   score_s=round(score_s, 3),
-                   score_examples_per_s=len(train_ds) * n_passes / score_s)
-        summary.update(n_kept=len(kept), score_wall_s=score_s)
-        train_subset = train_ds.subset(kept)
-    else:
-        train_subset = train_ds
+        return _retrain_level(cfg, train_ds, test_ds, scores,
+                              cfg.prune.sparsity, mesh=mesh, sharder=sharder,
+                              logger=logger,
+                              ckpt_dir=cfg.train.checkpoint_dir,
+                              tag="final", score_s=score_s)
 
-    res = fit_with_recovery(cfg, train_subset, test_ds, mesh=mesh, sharder=sharder,
+    res = fit_with_recovery(cfg, train_ds, test_ds, mesh=mesh, sharder=sharder,
                             logger=logger, checkpoint_dir=cfg.train.checkpoint_dir,
                             tag="final")
-    summary.update(
-        final_test_accuracy=res.final_test_accuracy,
-        train_wall_s=res.wall_s,
-        total_wall_s=time.perf_counter() - t0,
-    )
+    summary = {
+        "dataset": cfg.data.dataset, "n_train": len(train_ds),
+        "sparsity": cfg.prune.sparsity, "score_method": cfg.score.method,
+        "final_test_accuracy": res.final_test_accuracy,
+        "train_wall_s": res.wall_s,
+        "total_wall_s": time.perf_counter() - t0,
+    }
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
     return summary

@@ -101,3 +101,31 @@ def test_fit_resident_equals_streaming(tiny_cfg):
     a = np.asarray(res_a.state.params["classifier"]["kernel"])
     b = np.asarray(res_b.state.params["classifier"]["kernel"])
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_run_sweep_shares_one_scoring_pass(tmp_path):
+    """cli sweep: one scoring pass, one retrain per sparsity level, per-level
+    checkpoint dirs and summaries (reference equivalent: full re-runs)."""
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.train.loop import run_sweep
+
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=128",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "score.method=el2n", "score.pretrain_epochs=1", "score.seeds=[0]",
+        "train.num_epochs=1", "train.half_precision=false",
+        "prune.sweep=[0.25,0.5]", f"train.checkpoint_dir={tmp_path}/ck",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "train.log_every_steps=1000"])
+    summaries = run_sweep(cfg)
+    assert [s["sparsity"] for s in summaries] == [0.25, 0.5]
+    assert [s["n_kept"] for s in summaries] == [96, 64]
+    # One shared scoring pass: every level reports the same scoring wall time,
+    # and each level writes its own kept-set artifact.
+    assert len({s["score_wall_s"] for s in summaries}) == 1
+    import numpy as np
+    import os
+    for suffix, kept in (("s0p25", 96), ("s0p5", 64)):
+        assert os.path.isdir(f"{tmp_path}/ck_{suffix}")
+        data = np.load(f"{tmp_path}/ck_{suffix}_scores.npz")
+        assert data["scores"].shape == (128,) and len(data["kept"]) == kept
