@@ -72,6 +72,24 @@ retrain = fit(cfg, subset, test_ds, mesh=mesh, sharder=sharder,
               seed=cfg.train.seed + 1, tag="retrain")
 print(f"retrain on 50%: test_accuracy={retrain.final_test_accuracy:.3f}")
 
-# %% The whole pipeline above is one config-driven call (or `datadiet run ...`):
-# from data_diet_distributed_tpu.train.loop import run_datadiet
+# %% Forgetting-events scores (Toneva et al. 2019) — the third scoring method:
+# train-and-track instead of score-from-checkpoint. The tracker counts
+# correct->incorrect transitions per example across epochs.
+import copy
+
+from data_diet_distributed_tpu.train.loop import forgetting_scores
+from data_diet_distributed_tpu.obs import MetricsLogger
+
+cfg_f = copy.deepcopy(cfg)
+cfg_f.score.method = "forgetting"
+cfg_f.score.pretrain_epochs = 2
+forget = forgetting_scores(cfg_f, train_ds, mesh=mesh, sharder=sharder,
+                           logger=MetricsLogger(None, echo=False))
+print(f"forgetting: mean={forget.mean():.2f} events, "
+      f"never-learned={(forget > forget.max() - 0.5).sum()}")
+
+# %% The whole pipeline above is one config-driven call (or `datadiet run ...`);
+# a sparsity sweep shares one scoring pass across levels (`datadiet sweep ...`):
+# from data_diet_distributed_tpu.train.loop import run_datadiet, run_sweep
 # summary = run_datadiet(cfg)
+# summaries = run_sweep(cfg_with_prune_sweep)
