@@ -160,4 +160,29 @@ def plot_metrics(metrics_path: str, out_dir: str = "./plots",
     curve("epoch", "train_loss", "train_loss.png", "loss")
     curve("epoch", "test_accuracy", "eval_accuracy.png", "accuracy")
     curve("epoch", "examples_per_s", "throughput.png", "examples/sec")
+
+    # Sweep runs emit one summary per sparsity level — the accuracy-vs-sparsity
+    # trade-off curve is the sweep's headline result (Paul et al. 2021 fig. 1).
+    summaries = [r for r in records if r.get("kind") == "summary"
+                 and isinstance(r.get("sparsity"), (int, float))
+                 and isinstance(r.get("final_test_accuracy"), (int, float))]
+    sweep_pts = sorted((r["sparsity"], r["final_test_accuracy"])
+                       for r in summaries)
+    # Only a real sweep (distinct sparsity levels) gets the trade-off chart:
+    # appended logs from repeated single runs share one sparsity and would
+    # otherwise render run-to-run variance as a sparsity curve.
+    if len(sweep_pts) >= 2 and len({p[0] for p in sweep_pts}) >= 2:
+        method = summaries[-1].get("score_method", "")
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.plot([p[0] for p in sweep_pts], [p[1] for p in sweep_pts],
+                marker="o", lw=1.2)
+        ax.set_xlabel("sparsity (fraction of train set dropped)")
+        ax.set_ylabel("final test accuracy")
+        ax.set_title(f"Accuracy vs sparsity ({method})")
+        ax.set_xlim(0, 1)
+        fig.tight_layout()
+        path = os.path.join(out_dir, "accuracy_vs_sparsity.png")
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        written.append(path)
     return written

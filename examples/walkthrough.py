@@ -85,8 +85,10 @@ cfg_f.score.method = "forgetting"
 cfg_f.score.pretrain_epochs = 2
 forget = forgetting_scores(cfg_f, train_ds, mesh=mesh, sharder=sharder,
                            logger=MetricsLogger(None, echo=False))
+# Never-learned examples sit at the sentinel (updates + 1), strictly above
+# any possible event count (at most pretrain_epochs - 1 events).
 print(f"forgetting: mean={forget.mean():.2f} events, "
-      f"never-learned={(forget > forget.max() - 0.5).sum()}")
+      f"never-learned={(forget > cfg_f.score.pretrain_epochs).sum()}")
 
 # %% The whole pipeline above is one config-driven call (or `datadiet run ...`);
 # a sparsity sweep shares one scoring pass across levels (`datadiet sweep ...`):

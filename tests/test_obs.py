@@ -107,3 +107,29 @@ def test_plot_since_ts_filters_previous_runs(tmp_path):
 def test_plot_missing_file_is_noop(tmp_path):
     assert plot_utilization(str(tmp_path / "nope.jsonl")) == []
     assert plot_metrics(str(tmp_path / "nope.jsonl")) == []
+
+
+@requires_mpl
+def test_plot_sweep_accuracy_vs_sparsity(tmp_path):
+    mpath = str(tmp_path / "metrics.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    for s, acc in ((0.3, 0.91), (0.5, 0.90), (0.7, 0.84)):
+        logger.log("summary", sparsity=s, final_test_accuracy=acc,
+                   score_method="grand")
+    logger.close()
+    out = plot_metrics(mpath, str(tmp_path / "plots"))
+    assert any(os.path.basename(p) == "accuracy_vs_sparsity.png" for p in out)
+
+
+@requires_mpl
+def test_sweep_plot_requires_distinct_sparsities(tmp_path):
+    """Repeated single runs (one sparsity, appended log) must NOT render a
+    sparsity trade-off chart."""
+    mpath = str(tmp_path / "metrics.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    for acc in (0.90, 0.91):
+        logger.log("summary", sparsity=0.5, final_test_accuracy=acc,
+                   score_method="grand")
+    logger.close()
+    out = plot_metrics(mpath, str(tmp_path / "plots"))
+    assert not any("accuracy_vs_sparsity" in p for p in out)
