@@ -94,6 +94,9 @@ class PruneConfig:
 
     sparsity: float = 0.5      # fraction of the train set to DROP
     keep: str = "hardest"      # hardest | easiest | random (paper ablations)
+    # Apportion the kept budget per class proportionally (keep-hardest skews
+    # class balance at high sparsity — Paul et al. 2021 §5).
+    class_balance: bool = False
     # ``cli sweep``: retrain once per listed sparsity from ONE shared scoring
     # pass (scores are sparsity-independent). The BASELINE WRN-28-10 sweep
     # {0.3, 0.5, 0.7} is three reference runs, re-scoring each time; here it

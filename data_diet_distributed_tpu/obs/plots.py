@@ -166,12 +166,13 @@ def plot_metrics(metrics_path: str, out_dir: str = "./plots",
     summaries = [r for r in records if r.get("kind") == "summary"
                  and isinstance(r.get("sparsity"), (int, float))
                  and isinstance(r.get("final_test_accuracy"), (int, float))]
-    sweep_pts = sorted((r["sparsity"], r["final_test_accuracy"])
-                       for r in summaries)
-    # Only a real sweep (distinct sparsity levels) gets the trade-off chart:
-    # appended logs from repeated single runs share one sparsity and would
-    # otherwise render run-to-run variance as a sparsity curve.
-    if len(sweep_pts) >= 2 and len({p[0] for p in sweep_pts}) >= 2:
+    # Only a real sweep (distinct sparsity levels) gets the trade-off chart,
+    # and appended logs keep only the LATEST summary per level — repeated
+    # runs would otherwise render run-to-run variance as a sparsity curve.
+    latest_per_level: dict[float, float] = {
+        r["sparsity"]: r["final_test_accuracy"] for r in summaries}
+    sweep_pts = sorted(latest_per_level.items())
+    if len(sweep_pts) >= 2:
         method = summaries[-1].get("score_method", "")
         fig, ax = plt.subplots(figsize=(6, 4))
         ax.plot([p[0] for p in sweep_pts], [p[1] for p in sweep_pts],

@@ -5,8 +5,11 @@ The reference sorts 50k Python tuples on the host and keeps the top
 including the ``int()`` truncation — with deterministic tie-breaking (score desc, then
 global index asc; the reference's ``sorted`` on tuples had the same property by
 accident of tuple ordering) plus the paper's ``easiest`` / ``random`` ablation
-policies. Output is a sorted array of GLOBAL example ids, the only currency that
-crosses phase boundaries (never loader objects — SURVEY §2.4.2).
+policies and an optional class-balanced mode (keep-hardest skews the class
+distribution at high sparsity — Paul et al. 2021 §5 discusses the resulting
+imbalance; balancing allocates the kept budget proportionally per class).
+Output is a sorted array of GLOBAL example ids, the only currency that crosses
+phase boundaries (never loader objects — SURVEY §2.4.2).
 """
 
 from __future__ import annotations
@@ -18,25 +21,59 @@ def num_kept(n: int, sparsity: float) -> int:
     return int((1.0 - sparsity) * n)
 
 
+def _choose(scores: np.ndarray, indices: np.ndarray, k: int, keep: str,
+            rng: np.random.Generator) -> np.ndarray:
+    """Positions of the ``k`` selected rows under the given policy."""
+    if keep == "random":
+        return rng.permutation(len(scores))[:k]
+    key = -scores if keep == "hardest" else scores
+    # lexsort: primary=score direction, secondary=global index for determinism
+    return np.lexsort((indices, key))[:k]
+
+
+def _class_quotas(labels: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Per-class kept budgets summing exactly to ``k``, proportional to class
+    frequency (largest-remainder apportionment; ties broken by class id)."""
+    classes, counts = np.unique(labels, return_counts=True)
+    quotas = counts * (k / len(labels))
+    base = np.floor(quotas).astype(np.int64)
+    frac_order = np.lexsort((classes, -(quotas - base)))
+    base[frac_order[:k - int(base.sum())]] += 1
+    assert int(base.sum()) == k and (base <= counts).all()
+    return classes, base
+
+
 def select_indices(scores: np.ndarray, indices: np.ndarray, sparsity: float,
-                   keep: str = "hardest", seed: int = 0) -> np.ndarray:
+                   keep: str = "hardest", seed: int = 0,
+                   labels: np.ndarray | None = None,
+                   class_balance: bool = False) -> np.ndarray:
     """Return the global ids of the kept subset, sorted ascending.
 
     ``scores[i]`` belongs to example ``indices[i]``; ``sparsity`` is the fraction
     DROPPED. ``keep`` picks the policy: hardest (highest score — the Data Diet
-    default), easiest, or a score-blind random control.
+    default), easiest, or a score-blind random control. With ``class_balance``
+    (requires ``labels`` aligned with ``scores``), the kept budget is
+    apportioned per class proportionally to class frequency and the policy is
+    applied within each class.
     """
     if len(scores) != len(indices):
         raise ValueError("scores and indices must align")
     n = len(scores)
     k = num_kept(n, sparsity)
-    if keep == "random":
-        chosen = np.random.default_rng(seed).permutation(n)[:k]
+    rng = np.random.default_rng(seed)
+    if class_balance:
+        if labels is None or len(labels) != n:
+            raise ValueError("class_balance=True needs labels aligned with scores")
+        labels = np.asarray(labels)
+        chosen_parts = []
+        for cls, kc in zip(*_class_quotas(labels, k)):
+            rows = np.flatnonzero(labels == cls)
+            chosen_parts.append(rows[_choose(scores[rows], indices[rows],
+                                             int(kc), keep, rng)])
+        chosen = np.concatenate(chosen_parts) if chosen_parts else \
+            np.empty(0, np.int64)
     else:
-        key = -scores if keep == "hardest" else scores
-        # lexsort: primary=score direction, secondary=global index for determinism
-        order = np.lexsort((indices, key))
-        chosen = order[:k]
+        chosen = _choose(scores, indices, k, keep, rng)
     kept = np.sort(indices[chosen])
     assert len(kept) == k  # reference keeps this invariant (get_scores_and_prune.py:29)
     return kept

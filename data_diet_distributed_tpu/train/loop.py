@@ -491,7 +491,9 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
     """Shared prune→save-npz→retrain→summary block for one sparsity level
     (used by ``run_datadiet`` and each ``run_sweep`` level)."""
     kept = select_indices(scores, train_ds.indices, sparsity,
-                          keep=cfg.prune.keep, seed=cfg.train.seed)
+                          keep=cfg.prune.keep, seed=cfg.train.seed,
+                          labels=train_ds.labels,
+                          class_balance=cfg.prune.class_balance)
     if is_primary():   # every process holds the full scores; one writes
         np.savez(f"{ckpt_dir}_scores.npz", scores=scores,
                  indices=train_ds.indices, kept=kept)

@@ -50,3 +50,42 @@ def test_global_indices_respected():
 def test_misaligned_inputs_rejected():
     with pytest.raises(ValueError):
         select_indices(np.ones(3), np.arange(4), 0.5)
+
+
+class TestClassBalance:
+    def test_proportional_quotas(self):
+        """Skewed scores would keep only class 1; balancing apportions the
+        budget by class frequency and selects hardest WITHIN each class."""
+        rng = np.random.default_rng(0)
+        labels = np.array([0] * 60 + [1] * 40)
+        scores = np.where(labels == 1, 10.0, 0.0) + rng.random(100)
+        indices = np.arange(100)
+        kept = select_indices(scores, indices, sparsity=0.5, labels=labels,
+                              class_balance=True)
+        assert len(kept) == 50
+        kept_labels = labels[kept]
+        assert (kept_labels == 0).sum() == 30 and (kept_labels == 1).sum() == 20
+        # Unbalanced keep-hardest would have taken ALL of class 1 first.
+        unbalanced = select_indices(scores, indices, sparsity=0.5)
+        assert (labels[unbalanced] == 1).sum() == 40
+
+    def test_within_class_policy_is_hardest(self):
+        labels = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+        scores = np.array([1.0, 3.0, 2.0, 0.0, 5.0, 8.0, 7.0, 6.0])
+        kept = select_indices(scores, np.arange(8), sparsity=0.5,
+                              labels=labels, class_balance=True)
+        np.testing.assert_array_equal(kept, [1, 2, 5, 6])
+
+    def test_remainder_apportionment_is_exact_and_deterministic(self):
+        labels = np.array([0] * 3 + [1] * 3 + [2] * 3)   # k=4 over 3 classes
+        scores = np.arange(9, dtype=np.float64)
+        k1 = select_indices(scores, np.arange(9), sparsity=5 / 9.0,
+                            labels=labels, class_balance=True)
+        k2 = select_indices(scores, np.arange(9), sparsity=5 / 9.0,
+                            labels=labels, class_balance=True)
+        assert len(k1) == 4
+        np.testing.assert_array_equal(k1, k2)
+
+    def test_requires_labels(self):
+        with pytest.raises(ValueError, match="labels"):
+            select_indices(np.ones(4), np.arange(4), 0.5, class_balance=True)
