@@ -112,6 +112,21 @@ def main() -> None:
     results["scores_head"] = [float(v) for v in scores[:8]]
     results["scores_sum"] = float(scores.sum())
 
+    # Forgetting scores cross-process: the per-epoch correctness hook streams
+    # sharded batches and allgathers the per-example vector on every process.
+    import copy
+
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    from data_diet_distributed_tpu.train.loop import forgetting_scores
+    cfg_f = copy.deepcopy(cfg)
+    cfg_f.score.method = "forgetting"
+    cfg_f.score.pretrain_epochs = 1
+    cfg_f.train.checkpoint_dir = f"{out_dir}/unused_forget_ckpt"
+    forget = forgetting_scores(cfg_f, train_ds, mesh=mesh, sharder=sharder,
+                               logger=MetricsLogger(None, echo=False))
+    assert forget.shape == (256,)
+    results["forget_sum"] = float(forget.sum())
+
     # Cross-process Orbax restore: both processes restore the step saved above.
     from data_diet_distributed_tpu.checkpoint import CheckpointManager
     from data_diet_distributed_tpu.train.state import create_train_state

@@ -103,6 +103,9 @@ def test_processes_agree(multihost_results):
     assert r0["scores_head"] == pytest.approx(r1["scores_head"], rel=1e-6)
     assert r0["train_loss"] == pytest.approx(r1["train_loss"], rel=1e-5)
     assert r0["test_accuracy"] == pytest.approx(r1["test_accuracy"], abs=1e-9)
+    # Trajectory-based forgetting scores also agree across processes (the
+    # correctness hook allgathers one full vector per epoch on every host).
+    assert r0["forget_sum"] == pytest.approx(r1["forget_sum"], abs=1e-6)
 
 
 def test_multihost_matches_single_process(multihost_results, tmp_path):
