@@ -61,6 +61,12 @@ def main(argv: list[str] | None = None) -> int:
             try:
                 written = plot_metrics(cfg.obs.metrics_path, cfg.obs.plots_dir,
                                        since_ts=run_started)
+                if command in ("run", "score"):
+                    from .obs import plot_scores
+                    from .train.loop import scores_npz_path
+                    written += plot_scores(
+                        scores_npz_path(cfg.train.checkpoint_dir),
+                        cfg.obs.plots_dir)
                 if monitor:
                     written += plot_utilization(cfg.obs.monitor_path,
                                                 cfg.obs.plots_dir,
@@ -87,13 +93,13 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
     elif command == "score":
         from .data.pipeline import BatchSharder
         from .parallel.mesh import is_primary, make_mesh
-        from .train.loop import compute_scores, load_data_for
+        from .train.loop import compute_scores, load_data_for, scores_npz_path
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
         train_ds, _ = load_data_for(cfg)
         scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
                                 logger=logger)
-        out = f"{cfg.train.checkpoint_dir}_scores.npz"
+        out = scores_npz_path(cfg.train.checkpoint_dir)
         if is_primary():   # every process holds the full scores; one writes
             np.savez(out, scores=scores, indices=train_ds.indices)
         logger.log("scores_saved", path=out, n=len(scores),

@@ -1,7 +1,7 @@
 from .metrics import MetricsLogger
 from .monitor import ResourceMonitor, sample_devices
-from .plots import plot_metrics, plot_utilization
+from .plots import plot_metrics, plot_scores, plot_utilization
 from .profiler import StepTimer, trace
 
 __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
-           "trace", "plot_metrics", "plot_utilization"]
+           "trace", "plot_metrics", "plot_scores", "plot_utilization"]

@@ -479,6 +479,12 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
                          use_pallas=cfg.score.use_pallas)
 
 
+def scores_npz_path(checkpoint_dir: str) -> str:
+    """The one place the scores-artifact path convention lives (writer:
+    ``_retrain_level``/CLI ``score``; readers: CLI plotting, user tooling)."""
+    return f"{checkpoint_dir}_scores.npz"
+
+
 def _score_passes(cfg: Config) -> int:
     """How many dataset passes the configured scoring does (for throughput
     logging): a fixed scoring checkpoint means one pass regardless of seeds."""
@@ -495,8 +501,8 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                           labels=train_ds.labels,
                           class_balance=cfg.prune.class_balance)
     if is_primary():   # every process holds the full scores; one writes
-        np.savez(f"{ckpt_dir}_scores.npz", scores=scores,
-                 indices=train_ds.indices, kept=kept)
+        np.savez(scores_npz_path(ckpt_dir), scores=scores,
+                 indices=train_ds.indices, kept=kept, keep=cfg.prune.keep)
     logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
                score_s=round(score_s, 3),
                score_examples_per_s=(len(train_ds) * _score_passes(cfg)

@@ -133,3 +133,18 @@ def test_sweep_plot_requires_distinct_sparsities(tmp_path):
     logger.close()
     out = plot_metrics(mpath, str(tmp_path / "plots"))
     assert not any("accuracy_vs_sparsity" in p for p in out)
+
+
+@requires_mpl
+def test_plot_scores_histogram(tmp_path):
+    import numpy as np
+    from data_diet_distributed_tpu.obs import plot_scores
+    rng = np.random.default_rng(0)
+    scores = rng.random(500).astype(np.float32)
+    indices = np.arange(500)
+    kept = np.sort(indices[np.argsort(-scores)[:250]])
+    npz = str(tmp_path / "x_scores.npz")
+    np.savez(npz, scores=scores, indices=indices, kept=kept, keep="hardest")
+    out = plot_scores(npz, str(tmp_path / "plots"))
+    assert [os.path.basename(p) for p in out] == ["score_distribution.png"]
+    assert plot_scores(str(tmp_path / "missing.npz"), str(tmp_path)) == []
