@@ -66,11 +66,13 @@ class OptimConfig:
 class ScoreConfig:
     """Per-example scoring pass (reference: ``get_scores_and_prune.py``)."""
 
-    # el2n | grand | grand_vmap | grand_last_layer | forgetting. "grand" is
-    # full-parameter GraNd via the batched exact algorithm (ops/grand_batched.py)
-    # in eval mode; "grand_vmap" forces the naive vmap(grad) path (cross-checks,
-    # exotic layers); "forgetting" counts forgetting events across
-    # score.pretrain_epochs of training (Toneva et al. 2019, ops/forgetting.py).
+    # el2n | margin | grand | grand_vmap | grand_last_layer | forgetting.
+    # "grand" is full-parameter GraNd via the batched exact algorithm
+    # (ops/grand_batched.py) in eval mode; "grand_vmap" forces the naive
+    # vmap(grad) path (cross-checks, exotic layers); "margin" is the
+    # uncertainty-margin baseline max_{k≠y} p_k − p_y (higher = harder);
+    # "forgetting" counts forgetting events across score.pretrain_epochs of
+    # training (Toneva et al. 2019, ops/forgetting.py).
     method: str = "el2n"
     # Which checkpoint feeds the scoring pass. The reference hard-codes epoch 19
     # (train.py:61, ddp.py:72); here it is a knob.
@@ -182,7 +184,7 @@ class Config:
             if not 0.0 < s < 1.0:
                 raise ValueError(
                     f"prune.sweep entries must be in (0, 1), got {s}")
-        if self.score.method not in ("el2n", "grand", "grand_vmap",
+        if self.score.method not in ("el2n", "margin", "grand", "grand_vmap",
                                      "grand_last_layer", "forgetting"):
             raise ValueError(f"unknown score method {self.score.method!r}")
         if self.score.method == "forgetting" and self.score.pretrain_epochs < 1:

@@ -47,6 +47,19 @@ def el2n_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.sqrt(jnp.sum(err * err, axis=-1))
 
 
+def margin_from_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Margin difficulty per example: ``max_{k≠y} p_k − p_y`` ∈ [−1, 1].
+
+    The classic uncertainty-margin baseline, oriented so HIGHER = harder
+    (matches the keep-hardest pruning default, like EL2N/GraNd): confidently
+    correct examples score near −1, confused/mislabeled ones near +1."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    p_true = jnp.sum(probs * onehot, axis=-1)
+    p_other = jnp.max(probs - onehot, axis=-1)   # onehot subtraction masks y
+    return p_other - p_true
+
+
 def grand_last_layer_from_logits(logits: jax.Array, features: jax.Array,
                                  labels: jax.Array) -> jax.Array:
     """Exact GraNd restricted to the classifier layer, no backward needed."""
@@ -125,6 +138,17 @@ def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
         if use_pallas:
             return el2n_pallas(logits, label, mask)
         return el2n_from_logits(logits, label) * mask
+
+    return _wrap(local_scores, mesh)
+
+
+@functools.cache
+def make_margin_step(model, mesh: Mesh | None = None, eval_mode: bool = True):
+    """Forward-only margin difficulty over a (possibly mesh-sharded) batch."""
+
+    def local_scores(variables, image, label, mask):
+        logits = _forward(model, variables, image, eval_mode=eval_mode)
+        return margin_from_logits(logits, label) * mask
 
     return _wrap(local_scores, mesh)
 
@@ -227,13 +251,15 @@ def make_grand_batched_step(model, mesh: Mesh | None = None,
 @functools.cache
 def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 32,
                     eval_mode: bool = True, use_pallas: bool | None = None):
-    """Factory keyed by config string (el2n | grand | grand_vmap |
+    """Factory keyed by config string (el2n | margin | grand | grand_vmap |
     grand_last_layer). ``grand`` runs the batched exact algorithm in eval mode
     and falls back to ``vmap(grad)`` for train-mode (reference-quirk) scoring;
     ``grand_vmap`` forces the naive path (cross-checking, exotic layers)."""
     if method == "el2n":
         return make_el2n_step(model, mesh, eval_mode=eval_mode,
                               use_pallas=use_pallas)
+    if method == "margin":
+        return make_margin_step(model, mesh, eval_mode=eval_mode)
     if method == "grand":
         if eval_mode:
             return make_grand_batched_step(model, mesh, use_pallas=use_pallas)

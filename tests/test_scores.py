@@ -119,3 +119,43 @@ def test_eval_mode_flag_changes_bn_semantics():
     # and the pass must not have mutated the stored running stats
     again = np.asarray(make_el2n_step(model, eval_mode=True)(variables, batch))
     assert np.allclose(s_eval, again)
+
+
+def test_margin_hand_computed():
+    from data_diet_distributed_tpu.ops.scores import margin_from_logits
+    # Uniform logits: p = 1/4 each -> p_other - p_true = 0.
+    assert np.allclose(margin_from_logits(jnp.zeros((1, 4)), jnp.array([2])),
+                       [0.0], atol=1e-6)
+    # Confidently correct -> near -1; confidently wrong -> near +1.
+    logits = jnp.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    labels = jnp.array([0, 2])
+    m = np.asarray(margin_from_logits(logits, labels))
+    assert m[0] < -0.99 and m[1] > 0.99
+
+
+def test_margin_matches_definition_random():
+    from data_diet_distributed_tpu.ops.scores import margin_from_logits
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(32, 10)).astype(np.float32) * 3)
+    labels = np.asarray(rng.integers(0, 10, 32).astype(np.int32))
+    p = np.asarray(jax.nn.softmax(logits, axis=-1))
+    want = np.array([
+        max(p[i, k] for k in range(10) if k != labels[i]) - p[i, labels[i]]
+        for i in range(32)])
+    got = np.asarray(margin_from_logits(logits, jnp.asarray(labels)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_margin_step_dispatch(mesh8):
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder
+    model = create_model("tiny_cnn", 10)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(64, 16, 16, 3)).astype(np.float32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x[:1]))
+    batch = BatchSharder(mesh8)({
+        "image": x, "label": rng.integers(0, 10, 64).astype(np.int32),
+        "index": np.arange(64, dtype=np.int32),
+        "mask": np.ones(64, np.float32)})
+    step = make_score_step(model, "margin", mesh8)
+    got = np.asarray(step(variables, batch))
+    assert got.shape == (64,) and (got >= -1.0).all() and (got <= 1.0).all()
