@@ -58,6 +58,10 @@ def select_indices(scores: np.ndarray, indices: np.ndarray, sparsity: float,
     """
     if len(scores) != len(indices):
         raise ValueError("scores and indices must align")
+    if keep not in ("hardest", "easiest", "random"):
+        # Config.validate catches this for CLI runs; guard library callers too
+        # (an unknown string would otherwise silently behave as "easiest").
+        raise ValueError(f"unknown keep policy {keep!r}")
     n = len(scores)
     k = num_kept(n, sparsity)
     rng = np.random.default_rng(seed)

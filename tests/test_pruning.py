@@ -89,3 +89,8 @@ class TestClassBalance:
     def test_requires_labels(self):
         with pytest.raises(ValueError, match="labels"):
             select_indices(np.ones(4), np.arange(4), 0.5, class_balance=True)
+
+
+def test_unknown_keep_policy_rejected():
+    with pytest.raises(ValueError, match="keep policy"):
+        select_indices(np.ones(4), np.arange(4), 0.5, keep="banana")
