@@ -148,3 +148,21 @@ def test_plot_scores_histogram(tmp_path):
     out = plot_scores(npz, str(tmp_path / "plots"))
     assert [os.path.basename(p) for p in out] == ["score_distribution.png"]
     assert plot_scores(str(tmp_path / "missing.npz"), str(tmp_path)) == []
+
+
+def test_step_timer_and_trace(tmp_path):
+    import jax.numpy as jnp
+    from data_diet_distributed_tpu.obs import StepTimer, trace
+
+    t = StepTimer(warmup=2)
+    for s in (9.0, 8.0, 0.1, 0.2, 0.3):   # first two = compile, discarded
+        t.record(s)
+    assert t.times == [0.1, 0.2, 0.3]
+    assert t.mean == pytest.approx(0.2)
+
+    out = str(tmp_path / "trace")
+    with trace(out):
+        float(jnp.ones(()) + 1.0)
+    assert os.path.isdir(out)            # jax wrote a trace directory
+    with trace(None):                    # disabled path is a no-op
+        pass
