@@ -67,6 +67,17 @@ def main(argv: list[str] | None = None) -> int:
                     written += plot_scores(
                         scores_npz_path(cfg.train.checkpoint_dir),
                         cfg.obs.plots_dir)
+                elif command == "sweep":
+                    from .obs import plot_scores
+                    from .train.loop import (scores_npz_path, sweep_level_dir,
+                                             sweep_levels, sweep_suffix)
+                    for level in sweep_levels(cfg):
+                        written += plot_scores(
+                            scores_npz_path(sweep_level_dir(
+                                cfg.train.checkpoint_dir, level)),
+                            cfg.obs.plots_dir,
+                            name=("score_distribution_"
+                                  f"{sweep_suffix(level)}.png"))
                 if monitor:
                     written += plot_utilization(cfg.obs.monitor_path,
                                                 cfg.obs.plots_dir,
@@ -97,13 +108,15 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
         train_ds, _ = load_data_for(cfg)
-        scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                                logger=logger)
+        scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
+                                         sharder=sharder, logger=logger)
         out = scores_npz_path(cfg.train.checkpoint_dir)
         if is_primary():   # every process holds the full scores; one writes
             np.savez(out, scores=scores, indices=train_ds.indices)
         logger.log("scores_saved", path=out, n=len(scores),
-                   mean=float(scores.mean()), std=float(scores.std()))
+                   mean=float(scores.mean()), std=float(scores.std()),
+                   score_s=round(score_t["score_s"], 3),
+                   pretrain_s=round(score_t["pretrain_s"], 3))
 
 
 if __name__ == "__main__":

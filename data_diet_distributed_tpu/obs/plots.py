@@ -121,7 +121,8 @@ def plot_utilization(monitor_path: str, out_dir: str = "./plots",
     return written
 
 
-def plot_scores(npz_path: str, out_dir: str = "./plots") -> list[str]:
+def plot_scores(npz_path: str, out_dir: str = "./plots",
+                name: str = "score_distribution.png") -> list[str]:
     """Histogram of the saved per-example scores, with the kept/dropped cut
     marked when the npz carries a ``kept`` set — the automated version of the
     reference notebook's eyeballed score-distribution cells (``test.ipynb``)."""
@@ -136,26 +137,36 @@ def plot_scores(npz_path: str, out_dir: str = "./plots") -> list[str]:
         kept = data["kept"] if "kept" in data else None
         indices = data["indices"] if "indices" in data else None
         keep = str(data["keep"]) if "keep" in data else None
+        class_balance = bool(data["class_balance"]) if "class_balance" in data \
+            else False
     os.makedirs(out_dir, exist_ok=True)
     fig, ax = plt.subplots(figsize=(6, 4))
     ax.hist(scores, bins=min(80, max(10, len(scores) // 50)))
     if (kept is not None and indices is not None
             and 0 < len(kept) < len(scores)
-            # The cut line is only meaningful for threshold policies: hardest
-            # cuts at min(kept), easiest at max(kept); random has no cut.
+            # The cut line is only meaningful for GLOBAL threshold policies:
+            # hardest cuts at min(kept), easiest at max(kept); random has no
+            # cut, and class-balanced pruning uses per-class thresholds — a
+            # single global line there would be misleading, so the kept count
+            # is annotated without one.
             and keep in ("hardest", "easiest")):
         kept_mask = np.isin(indices, kept)
         if kept_mask.any():
-            cut = (scores[kept_mask].min() if keep == "hardest"
-                   else scores[kept_mask].max())
-            ax.axvline(float(cut), color="C3", lw=1.2,
-                       label=f"kept {kept_mask.sum()}/{len(scores)} ({keep})")
+            if class_balance:
+                ax.plot([], [], " ",
+                        label=(f"kept {kept_mask.sum()}/{len(scores)} "
+                               f"({keep}, per-class cuts)"))
+            else:
+                cut = (scores[kept_mask].min() if keep == "hardest"
+                       else scores[kept_mask].max())
+                ax.axvline(float(cut), color="C3", lw=1.2,
+                           label=f"kept {kept_mask.sum()}/{len(scores)} ({keep})")
             ax.legend()
     ax.set_xlabel("score")
     ax.set_ylabel("examples")
     ax.set_title(os.path.basename(npz_path))
     fig.tight_layout()
-    path = os.path.join(out_dir, "score_distribution.png")
+    path = os.path.join(out_dir, name)
     fig.savefig(path, dpi=100)
     plt.close(fig)
     return [path]

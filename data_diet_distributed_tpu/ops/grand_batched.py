@@ -61,11 +61,24 @@ _F32 = jnp.float32
 import os as _os
 
 
+_TOGGLE_TRUE = frozenset(("1", "true", "on", "yes"))
+_TOGGLE_FALSE = frozenset(("0", "false", "off", "no", ""))
+
+
 def _toggle(name: str, default: bool) -> bool:
     v = _os.environ.get(name)
     if v is None:
         return default
-    return v.strip().lower() not in ("0", "false", "off", "no", "")
+    s = v.strip().lower()
+    if s in _TOGGLE_TRUE:
+        return True
+    if s in _TOGGLE_FALSE:
+        return False
+    # A typo in a bisection run must not silently enable an experimental
+    # kernel path.
+    raise ValueError(
+        f"{name}={v!r}: expected one of "
+        f"{sorted(_TOGGLE_TRUE | _TOGGLE_FALSE)}")
 
 
 GROUP_CONV = _toggle("DDT_GRAND_GROUP_CONV", False)

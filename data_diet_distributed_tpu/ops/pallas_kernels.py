@@ -219,8 +219,10 @@ def _catdot_vmem(hp, wp, c, ho, wo, k, kh, kw, itemsize) -> int:
     acat = tile * ho * pad8(wp) * padl(kh * c) * itemsize
     gcat = tile * ho * pad8(wp) * padl(kw * k) * itemsize
     m = tile * pad8(kh * c) * padl(kw * k) * 4
-    # gcols temps roughly double g_cat during the build.
-    return blocks + acat + 2 * gcat + m
+    # Build temporaries roughly double BOTH concatenated operands: the kh
+    # row-shifted a slices and the kw zero-embedded g columns are each
+    # materialized before their jnp.concatenate.
+    return blocks + 2 * acat + 2 * gcat + m
 
 
 def _catdot_ok(hp, wp, c, ho, wo, k, kh, kw, itemsize) -> bool:

@@ -460,23 +460,35 @@ def forgetting_scores(cfg: Config, train_ds: ArrayDataset, *,
 
 
 def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
-                   mesh, sharder, logger) -> np.ndarray:
+                   mesh, sharder, logger) -> tuple[np.ndarray, dict[str, float]]:
     """Dispatch the configured scoring method to its driver: checkpoint-based
     scores (EL2N / GraNd family) go through ``score_dataset`` over per-seed
-    scoring models; trajectory-based forgetting scores train-and-track."""
+    scoring models; trajectory-based forgetting scores train-and-track.
+
+    Returns ``(scores, timings)`` with ``timings = {"pretrain_s", "score_s"}``
+    separated, so throughput reporting never folds multi-seed pretraining into
+    the scoring rate. Forgetting is trajectory-based — its training IS the
+    scoring pass, so the whole wall lands in ``score_s``.
+    """
+    t0 = time.perf_counter()
     if cfg.score.method == "forgetting":
-        return forgetting_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                                 logger=logger)
+        scores = forgetting_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                                   logger=logger)
+        return scores, {"pretrain_s": 0.0, "score_s": time.perf_counter() - t0}
     seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
                                            sharder=sharder, logger=logger)
+    pretrain_s = time.perf_counter() - t0
     model = create_model(cfg.model.arch, cfg.model.num_classes,
                          cfg.train.half_precision, stem=cfg.model.stem)
-    return score_dataset(model, seeds_vars, train_ds,
-                         method=cfg.score.method,
-                         batch_size=cfg.score.batch_size,
-                         sharder=sharder, chunk=cfg.score.grand_chunk,
-                         eval_mode=cfg.score.eval_mode,
-                         use_pallas=cfg.score.use_pallas)
+    t1 = time.perf_counter()
+    scores = score_dataset(model, seeds_vars, train_ds,
+                           method=cfg.score.method,
+                           batch_size=cfg.score.batch_size,
+                           sharder=sharder, chunk=cfg.score.grand_chunk,
+                           eval_mode=cfg.score.eval_mode,
+                           use_pallas=cfg.score.use_pallas)
+    return scores, {"pretrain_s": pretrain_s,
+                    "score_s": time.perf_counter() - t1}
 
 
 def scores_npz_path(checkpoint_dir: str) -> str:
@@ -493,18 +505,27 @@ def _score_passes(cfg: Config) -> int:
 
 def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                    mesh, sharder, logger, ckpt_dir: str, tag: str,
-                   score_s: float) -> dict[str, Any]:
+                   score_t: dict[str, float],
+                   scoring_shared: bool = False) -> dict[str, Any]:
     """Shared prune→save-npz→retrain→summary block for one sparsity level
-    (used by ``run_datadiet`` and each ``run_sweep`` level)."""
+    (used by ``run_datadiet`` and each ``run_sweep`` level).
+
+    ``scoring_shared``: the scoring pass was paid ONCE for several levels (a
+    sweep) — the per-level summary still records the shared pretrain/score
+    walls for reference, but ``total_wall_s`` charges only this level's
+    retrain; the sweep's true end-to-end wall is logged once by ``run_sweep``.
+    """
     kept = select_indices(scores, train_ds.indices, sparsity,
                           keep=cfg.prune.keep, seed=cfg.train.seed,
                           labels=train_ds.labels,
                           class_balance=cfg.prune.class_balance)
     if is_primary():   # every process holds the full scores; one writes
         np.savez(scores_npz_path(ckpt_dir), scores=scores,
-                 indices=train_ds.indices, kept=kept, keep=cfg.prune.keep)
+                 indices=train_ds.indices, kept=kept, keep=cfg.prune.keep,
+                 class_balance=cfg.prune.class_balance)
+    score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
     logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
-               score_s=round(score_s, 3),
+               score_s=round(score_s, 3), pretrain_s=round(pretrain_s, 3),
                score_examples_per_s=(len(train_ds) * _score_passes(cfg)
                                      / score_s))
     res = fit_with_recovery(cfg, train_ds.subset(kept), test_ds, mesh=mesh,
@@ -514,12 +535,39 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
         "sparsity": float(sparsity), "score_method": cfg.score.method,
         "n_kept": len(kept), "score_wall_s": score_s,
+        "pretrain_wall_s": pretrain_s,
         "final_test_accuracy": res.final_test_accuracy,
         "train_wall_s": res.wall_s,
-        "total_wall_s": score_s + res.wall_s,
+        "total_wall_s": (res.wall_s if scoring_shared
+                         else pretrain_s + score_s + res.wall_s),
     }
+    if scoring_shared:
+        summary["scoring_shared"] = True
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
     return summary
+
+
+def sweep_suffix(sparsity: float) -> str:
+    """Collision-free suffix for any float level: 0.333 -> s0p333."""
+    return f"s{float(sparsity):g}".replace(".", "p")
+
+
+def sweep_level_dir(checkpoint_dir: str, sparsity: float) -> str:
+    """Per-level checkpoint dir for a sweep — one definition so the CLI's
+    plotting can find every level's scores npz (ADVICE r3)."""
+    return f"{checkpoint_dir}_{sweep_suffix(sparsity)}"
+
+
+def sweep_levels(cfg: Config) -> tuple[float, ...]:
+    """The sweep's sparsity levels — ONE definition shared by ``run_sweep`` and
+    the CLI's per-level plotting, so the plot lookup can never drift from the
+    levels the run actually produced."""
+    if cfg.prune.sweep:
+        return tuple(float(s) for s in cfg.prune.sweep)
+    if not 0.0 < cfg.prune.sparsity < 1.0:
+        raise ValueError("cli sweep needs prune.sweep levels (or a single "
+                         "prune.sparsity in (0, 1))")
+    return (float(cfg.prune.sparsity),)
 
 
 def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str, Any]]:
@@ -529,35 +577,34 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
     cost once — the reference's equivalent (BASELINE WRN-28-10 {30,50,70}%
     sweep) is three full runs, each redoing its scoring pass. Each level
     retrains from scratch into its own checkpoint dir
-    (``<checkpoint_dir>_s<level>``) and reports its own summary.
+    (``<checkpoint_dir>_s<level>``) and reports its own summary; the shared
+    scoring cost is charged once, in the final ``sweep_done`` record.
     """
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
-    sweep = cfg.prune.sweep
-    if not sweep:
-        if not 0.0 < cfg.prune.sparsity < 1.0:
-            raise ValueError("cli sweep needs prune.sweep levels (or a single "
-                             "prune.sparsity in (0, 1))")
-        sweep = (cfg.prune.sparsity,)
+    sweep = sweep_levels(cfg)
     mesh = make_mesh(cfg.mesh)
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
 
-    t_score = time.perf_counter()
-    scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                            logger=logger)
-    score_s = time.perf_counter() - t_score
-    logger.log("sweep_scored", n=len(train_ds), score_s=round(score_s, 3),
+    scores, score_t = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+                                     logger=logger)
+    logger.log("sweep_scored", n=len(train_ds),
+               score_s=round(score_t["score_s"], 3),
+               pretrain_s=round(score_t["pretrain_s"], 3),
                levels=list(sweep))
 
     summaries = []
     for sparsity in sweep:
-        # Collision-free suffix for any float level: 0.333 -> s0p333.
-        suffix = f"s{float(sparsity):g}".replace(".", "p")
         summaries.append(_retrain_level(
             cfg, train_ds, test_ds, scores, float(sparsity), mesh=mesh,
             sharder=sharder, logger=logger,
-            ckpt_dir=f"{cfg.train.checkpoint_dir}_{suffix}",
-            tag=f"final_{suffix}", score_s=score_s))
+            ckpt_dir=sweep_level_dir(cfg.train.checkpoint_dir, sparsity),
+            tag=f"final_{sweep_suffix(sparsity)}", score_t=score_t,
+            scoring_shared=True))
+    logger.log("sweep_done", levels=list(sweep),
+               total_wall_s=round(score_t["pretrain_s"] + score_t["score_s"]
+                                  + sum(s["train_wall_s"] for s in summaries),
+                                  3))
     return summaries
 
 
@@ -570,15 +617,13 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
 
     t0 = time.perf_counter()
     if cfg.prune.sparsity > 0.0:
-        t_score = time.perf_counter()
-        scores = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                                logger=logger)
-        score_s = time.perf_counter() - t_score
+        scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
+                                         sharder=sharder, logger=logger)
         return _retrain_level(cfg, train_ds, test_ds, scores,
                               cfg.prune.sparsity, mesh=mesh, sharder=sharder,
                               logger=logger,
                               ckpt_dir=cfg.train.checkpoint_dir,
-                              tag="final", score_s=score_s)
+                              tag="final", score_t=score_t)
 
     res = fit_with_recovery(cfg, train_ds, test_ds, mesh=mesh, sharder=sharder,
                             logger=logger, checkpoint_dir=cfg.train.checkpoint_dir,

@@ -1,0 +1,83 @@
+"""bench.py hardening: the driver must get ONE parseable JSON line even when the
+accelerator backend cannot initialize (the relay wedge that killed BENCH_r03)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parse_mesh():
+    bench = _load_bench()
+    assert bench.parse_mesh(None) is None
+    assert bench.parse_mesh("4x2") == (4, 2)
+    assert bench.parse_mesh("8X1") == (8, 1)
+    with pytest.raises(SystemExit):
+        bench.parse_mesh("nonsense")
+
+
+def test_bench_emits_error_json_when_backend_unavailable():
+    """A broken backend must yield rc=0 and a JSON line with an "error" field —
+    not a hang, not a stack trace (VERDICT r3 weak #1)."""
+    env = dict(os.environ, JAX_PLATFORMS="bogus", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--size", "64",
+         "--batch", "32", "--arch", "tiny_cnn",
+         "--probe-attempts", "1", "--probe-timeout", "60"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "grand_scoring_examples_per_sec_per_chip"
+    assert line["value"] == 0.0
+    assert "error" in line and "backend init failed" in line["error"]
+
+
+def test_probe_backend_retries_then_reports(monkeypatch):
+    bench = _load_bench()
+
+    calls = []
+
+    class FakeProc:
+        returncode = 1
+        stdout = ""
+        stderr = "RuntimeError: Unable to initialize backend 'axon'"
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return FakeProc()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info = bench.probe_backend(attempts=3, timeout_s=1.0)
+    assert len(calls) == 3
+    assert "error" in info
+    assert "Unable to initialize backend 'axon'" in info["error"]
+
+
+def test_probe_backend_success(monkeypatch):
+    bench = _load_bench()
+
+    class FakeProc:
+        returncode = 0
+        stdout = '{"n": 1, "platform": "tpu"}\n'
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda cmd, **kw: FakeProc())
+    info = bench.probe_backend(attempts=1, timeout_s=1.0)
+    assert info == {"n": 1, "platform": "tpu"}

@@ -231,3 +231,19 @@ def test_grouped_dispatch_matches_ungrouped(monkeypatch):
         got = run(**full)
         np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6,
                                    err_msg=str(flags))
+
+
+def test_toggle_rejects_unrecognized_values(monkeypatch):
+    """A typo'd DDT_GRAND_* env value must fail loudly, not silently enable an
+    experimental kernel path (ADVICE r3)."""
+    import pytest
+    from data_diet_distributed_tpu.ops.grand_batched import _toggle
+    monkeypatch.setenv("DDT_GRAND_TEST_FLAG", "maybe")
+    with pytest.raises(ValueError, match="DDT_GRAND_TEST_FLAG"):
+        _toggle("DDT_GRAND_TEST_FLAG", False)
+    for v, want in (("1", True), ("TRUE", True), (" on ", True),
+                    ("0", False), ("Off", False), ("", False)):
+        monkeypatch.setenv("DDT_GRAND_TEST_FLAG", v)
+        assert _toggle("DDT_GRAND_TEST_FLAG", not want) is want
+    monkeypatch.delenv("DDT_GRAND_TEST_FLAG")
+    assert _toggle("DDT_GRAND_TEST_FLAG", True) is True

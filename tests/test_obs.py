@@ -166,3 +166,20 @@ def test_step_timer_and_trace(tmp_path):
     assert os.path.isdir(out)            # jax wrote a trace directory
     with trace(None):                    # disabled path is a no-op
         pass
+
+
+@requires_mpl
+def test_plot_scores_class_balanced_skips_global_cut(tmp_path):
+    """Class-balanced pruning uses per-class thresholds — the plot must not
+    draw a (misleading) single global cut line (ADVICE r3)."""
+    import numpy as np
+    from data_diet_distributed_tpu.obs import plot_scores
+    rng = np.random.default_rng(1)
+    scores = rng.random(200).astype(np.float32)
+    indices = np.arange(200)
+    kept = np.sort(indices[np.argsort(-scores)[:100]])
+    npz = str(tmp_path / "cb_scores.npz")
+    np.savez(npz, scores=scores, indices=indices, kept=kept, keep="hardest",
+             class_balance=True)
+    out = plot_scores(npz, str(tmp_path / "plots"), name="cb.png")
+    assert [os.path.basename(p) for p in out] == ["cb.png"]

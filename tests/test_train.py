@@ -47,6 +47,12 @@ def test_run_datadiet_end_to_end(tiny_cfg):
     assert summary["n_kept"] == 128  # int(0.5 * 256)
     assert summary["final_test_accuracy"] is not None
     assert summary["score_wall_s"] > 0
+    # Pretraining (1 epoch here) is timed SEPARATELY from the scoring pass, so
+    # score_wall_s is a scoring rate, not scoring+pretrain (ADVICE r3).
+    assert summary["pretrain_wall_s"] > 0
+    assert summary["total_wall_s"] >= (summary["pretrain_wall_s"]
+                                       + summary["score_wall_s"]
+                                       + summary["train_wall_s"])
 
 
 def test_run_datadiet_multiseed_and_grand(tiny_cfg):
@@ -123,6 +129,11 @@ def test_run_sweep_shares_one_scoring_pass(tmp_path):
     # One shared scoring pass: every level reports the same scoring wall time,
     # and each level writes its own kept-set artifact.
     assert len({s["score_wall_s"] for s in summaries}) == 1
+    # The shared cost is charged ONCE (sweep_done), not once per level: each
+    # level's total is its own retrain only.
+    for s in summaries:
+        assert s["scoring_shared"] is True
+        assert s["total_wall_s"] == s["train_wall_s"]
     import numpy as np
     import os
     for suffix, kept in (("s0p25", 96), ("s0p5", 64)):
