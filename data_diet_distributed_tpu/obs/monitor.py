@@ -7,13 +7,15 @@ design: a daemon thread (no fork, no IPC), JSONL output (no parsing step), host 
 from ``/proc/stat`` (no psutil dependency), and device memory from
 ``Device.memory_stats()`` (the TPU equivalent of ``torch.cuda.memory_allocated``).
 
-Device duty cycle (the reference sampled GPU utilization %, ``ddp_new.py:37-39``;
+Device duty cycle (the reference sampled per-GPU utilization %, ``ddp_new.py:37-39``;
 TPU exposes no such counter to the host): estimated by latency probes. A scalar
 add is enqueued on the device stream; it completes immediately on an idle device
 and waits behind queued step work on a busy one, so "probe latency above the idle
 baseline" ⟺ "device was busy when the probe landed". Several probes per sample
-window turn that into a busy fraction. The probes themselves are a scalar op
-every ~quarter second — unmeasurable against training step work.
+window turn that into a busy fraction, PER LOCAL DEVICE (each device gets its
+own probe array, compiled fn, and idle baseline; duty is reported per device in
+the ``devices`` list plus a top-level mean). The probes themselves are a scalar
+op every ~quarter second per device — unmeasurable against training step work.
 """
 
 from __future__ import annotations
@@ -34,7 +36,7 @@ def _cpu_times() -> tuple[float, float]:
 
 
 class _DutyProbe:
-    """Busy-fraction estimator from device-stream latency probes.
+    """Busy-fraction estimator from device-stream latency probes, one device.
 
     Baseline contract: the monitor should start BEFORE training dispatch begins
     (the CLI does — the monitor context opens around the whole run), so the
@@ -49,9 +51,11 @@ class _DutyProbe:
     # to the transport: ~µs in-process, ~ms over a tunneled runtime).
     BUSY_FACTOR = 3.0
 
-    def __init__(self):
+    def __init__(self, device=None):
         import jax.numpy as jnp
-        self._x = jax.device_put(jnp.zeros((), jnp.float32))
+        self._x = jax.device_put(jnp.zeros((), jnp.float32), device)
+        # jit dispatches to the committed argument's device — one compiled fn
+        # per probe keeps each device's stream independently observed.
         self._fn = jax.jit(lambda x: x + 1.0)
         self._base_ms = None
         for _ in range(3):        # warm compile + settle the baseline
@@ -67,16 +71,42 @@ class _DutyProbe:
             self._base_ms = ms
         return ms
 
-    def sample(self, window_s: float, n: int = 4) -> dict:
-        """n probes spread over ``window_s``; returns busy fraction + latency."""
-        lats = []
-        for j in range(n):
-            lats.append(self.probe_ms())
-            time.sleep(max(0.0, window_s / n - lats[-1] / 1e3))
+    def stats(self, lats: list[float]) -> dict:
         busy = sum(1 for m in lats if m > self.BUSY_FACTOR * self._base_ms)
-        return {"duty_cycle": busy / n,
-                "probe_ms": round(sum(lats) / n, 3),
+        return {"duty_cycle": busy / len(lats),
+                "probe_ms": round(sum(lats) / len(lats), 3),
                 "probe_base_ms": round(self._base_ms, 3)}
+
+
+class _DutyProbes:
+    """One probe per LOCAL DEVICE (the reference logged per-GPU utilization,
+    ``ddp_new.py:37-39``; a single default-device probe would report one chip's
+    busyness as "the" duty cycle on a multi-chip host — VERDICT r3 weak #5)."""
+
+    def __init__(self):
+        self.probes = {str(d): _DutyProbe(d) for d in jax.local_devices()}
+
+    def sample(self, window_s: float, n: int = 4) -> tuple[dict, dict]:
+        """n probe rounds spread over ``window_s``, each round touching every
+        device sequentially (true per-device latency). Returns
+        ``(aggregate, per_device)``: the aggregate keeps the historical
+        top-level fields (duty = mean over devices); per_device maps
+        ``str(device)`` to its own duty/latency stats."""
+        lats: dict[str, list[float]] = {k: [] for k in self.probes}
+        for _ in range(n):
+            t_round = time.perf_counter()
+            for k, p in self.probes.items():
+                lats[k].append(p.probe_ms())
+            spent = time.perf_counter() - t_round
+            time.sleep(max(0.0, window_s / n - spent))
+        per_device = {k: p.stats(lats[k]) for k, p in self.probes.items()}
+        vals = list(per_device.values())
+        aggregate = {
+            "duty_cycle": round(sum(v["duty_cycle"] for v in vals) / len(vals), 3),
+            "probe_ms": round(sum(v["probe_ms"] for v in vals) / len(vals), 3),
+            "probe_base_ms": round(min(v["probe_base_ms"] for v in vals), 3),
+        }
+        return aggregate, per_device
 
 
 def sample_devices() -> list[dict]:
@@ -106,18 +136,24 @@ class ResourceMonitor:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "ResourceMonitor":
+        # Probes are built HERE, synchronously, before the caller dispatches
+        # any device work: the warmup probes then observe idle devices and pin
+        # a correct idle baseline (building them inside the daemon thread
+        # raced the first training dispatch — on a saturated stream the warmup
+        # blocks behind the whole queue and the monitor writes nothing).
+        self._probes = None
+        if self.probe_duty:
+            try:
+                self._probes = _DutyProbes()
+            except Exception:      # no device / backend not initializable here
+                self._probes = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         return self
 
     def _run(self) -> None:
         prev_total, prev_idle = _cpu_times()
-        probe = None
-        if self.probe_duty:
-            try:
-                probe = _DutyProbe()
-            except Exception:      # no device / backend not initializable here
-                probe = None
+        probes = self._probes
         with open(self.path, "a", buffering=1) as fh:
             while not self._stop.is_set():
                 # The duty probes ARE the wait when enabled (they sleep through
@@ -125,22 +161,26 @@ class ResourceMonitor:
                 # failure (backend teardown racing this daemon thread, runtime
                 # hiccup) must not kill CPU/HBM sampling: disable probing and
                 # carry on.
-                duty = None
-                if probe is not None:
+                duty, per_device = None, {}
+                if probes is not None:
                     try:
-                        duty = probe.sample(self.interval_s)
+                        duty, per_device = probes.sample(self.interval_s)
                     except Exception:
-                        probe = None
-                if probe is None and self._stop.wait(self.interval_s):
+                        probes = None
+                if probes is None and self._stop.wait(self.interval_s):
                     break
                 total, idle = _cpu_times()
                 dt, di = total - prev_total, idle - prev_idle
                 prev_total, prev_idle = total, idle
                 cpu_pct = 100.0 * (1.0 - di / dt) if dt > 0 else 0.0
+                devices = sample_devices()
+                for d in devices:   # per-device duty next to per-device HBM
+                    if d["device"] in per_device:
+                        d.update(per_device[d["device"]])
                 rec = {
                     "ts": round(time.time(), 3),
                     "cpu_pct": round(cpu_pct, 1),
-                    "devices": sample_devices(),
+                    "devices": devices,
                 }
                 if duty is not None:
                     rec.update(duty)

@@ -1,0 +1,25 @@
+"""PyTorch-semantics oracle for cross-framework score comparison.
+
+Two jobs:
+
+* weight-port parity (``tests/test_parity_torch.py``): port Flax weights into
+  torch mirrors with identical module naming and compare scores at float
+  tolerance — catches numerics drift exactly;
+* independently-trained parity (``tools/cross_framework_parity.py``): train the
+  torch side FROM SCRATCH with the reference recipe (SGD + momentum + weight
+  decay + cosine, ``/root/reference/train.py:76-77``) and measure the Spearman
+  rank correlation an adopter would actually see when switching frameworks —
+  the literal BASELINE "rho vs PyTorch scores" semantics.
+
+The torch models here are written from the standard architecture definitions
+(mirroring the Flax module structure for mechanical weight porting), not copied
+from the reference.
+"""
+
+from .torch_models import (TorchBasicBlock, TorchResNet18, TorchTinyCNN,
+                           port_flax_to_torch, torch_el2n, torch_grand)
+from .train import train_torch_from_scratch
+
+__all__ = ["TorchTinyCNN", "TorchBasicBlock", "TorchResNet18",
+           "port_flax_to_torch", "torch_el2n", "torch_grand",
+           "train_torch_from_scratch"]

@@ -1,0 +1,56 @@
+"""From-scratch torch training with the reference recipe, for the
+independently-trained cross-framework parity experiment.
+
+Recipe parity with the TPU framework's ``fit`` (both follow the reference,
+``/root/reference/train.py:76-77,80-83`` modulo its off-by-one):
+
+* SGD + momentum + weight decay, cosine-annealed PER STEP over
+  ``num_epochs * steps_per_epoch`` (optax ``cosine_decay_schedule`` and torch
+  ``CosineAnnealingLR`` share the ``(1 + cos(pi t/T))/2`` form);
+* cross-entropy loss, mean over the batch;
+* fresh shuffle every epoch (reference quirk §2.4.6 fixed on both sides);
+* BatchNorm running stats updated in train mode, eval-mode scoring after.
+
+What is deliberately NOT aligned: parameter initialization (each framework
+uses its native init) and shuffle order (independent RNGs). That is the point
+of the experiment — the measured rho is what a user switching frameworks with
+the same config would observe, not the weight-port upper bound.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+
+def train_torch_from_scratch(model, images_nhwc: np.ndarray, labels: np.ndarray,
+                             *, num_epochs: int, batch_size: int,
+                             lr: float = 0.01, momentum: float = 0.9,
+                             weight_decay: float = 5e-4, seed: int = 0):
+    """Train ``model`` in place; returns it in eval mode."""
+    torch.manual_seed(seed)
+    x = torch.tensor(np.ascontiguousarray(
+        images_nhwc.transpose(0, 3, 1, 2)), dtype=torch.float32)
+    y = torch.tensor(np.asarray(labels), dtype=torch.int64)
+    n = len(y)
+    steps_per_epoch = max(1, (n + batch_size - 1) // batch_size)
+    opt = torch.optim.SGD(model.parameters(), lr=lr, momentum=momentum,
+                          weight_decay=weight_decay)
+    sched = torch.optim.lr_scheduler.CosineAnnealingLR(
+        opt, T_max=max(1, num_epochs * steps_per_epoch))
+    gen = torch.Generator().manual_seed(seed)
+    model.train()
+    for _ in range(num_epochs):
+        perm = torch.randperm(n, generator=gen)
+        for s in range(steps_per_epoch):
+            idx = perm[s * batch_size:(s + 1) * batch_size]
+            if len(idx) == 0:
+                continue
+            opt.zero_grad(set_to_none=True)
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            opt.step()
+            sched.step()
+    model.eval()
+    return model

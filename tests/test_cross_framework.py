@@ -1,0 +1,74 @@
+"""Independently-trained cross-framework parity (VERDICT r3 next #3).
+
+The weight-port tests (test_parity_torch.py) pin exact numerics; this pins the
+EXPERIMENT: train this framework and the torch oracle each from scratch (same
+data/recipe/seed policy, native inits) and check that the cross-framework
+Spearman rho of seed-averaged scores sits at the within-framework seed-noise
+floor — i.e. switching frameworks costs no more agreement than switching seeds.
+
+The committed full-size artifact (artifacts/cross_framework_parity.npz, from
+``tools/cross_framework_parity.py --size 2048 --epochs 10 --seeds 0 1 2``) is
+validated for self-consistency; the live run here is a scaled-down version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from data_diet_distributed_tpu.utils.stats import spearman  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "cross_framework_parity", REPO / "tools" / "cross_framework_parity.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_independently_trained_rho_at_seed_noise_floor():
+    """Scaled-down live run: cross-framework rho must reach the
+    within-framework floor (measured ~0.93 cross vs ~0.93 within at these
+    settings; thresholds leave noise margin)."""
+    tool = _load_tool()
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+
+    args = argparse.Namespace(size=1024, epochs=6, batch=128, lr=0.02,
+                              arch="tiny_cnn", seeds=[0, 1], methods=["el2n"])
+    train_ds, _ = load_dataset("synthetic", synthetic_size=args.size, seed=0)
+    jx = tool.jax_scores_per_seed(args, train_ds, "el2n")
+    th = tool.torch_scores_per_seed(args, train_ds, "el2n")
+
+    rho_cross = spearman(np.mean(jx, axis=0), np.mean(th, axis=0))
+    rho_within = min(tool.mean_pairwise_rho(jx), tool.mean_pairwise_rho(th))
+    assert rho_cross > 0.8, (rho_cross, rho_within)
+    # No cross-framework bias: cross agreement >= within-framework seed
+    # agreement (up to noise margin).
+    assert rho_cross > rho_within - 0.1, (rho_cross, rho_within)
+
+
+def test_committed_artifact_is_self_consistent():
+    """The committed full-size artifact's recorded rhos must match a
+    recomputation from its own stored per-seed scores."""
+    path = REPO / "artifacts" / "cross_framework_parity.npz"
+    assert path.exists(), "full-size experiment artifact not committed"
+    with np.load(path) as d:
+        cfg = json.loads(str(d["config"]))
+        assert cfg["size"] >= 2048 and len(d["seeds"]) >= 3
+        for method in cfg["methods"]:
+            jx, th = d[f"jax_{method}"], d[f"torch_{method}"]
+            assert jx.shape == th.shape == (len(d["seeds"]), cfg["size"])
+            rho = spearman(jx.mean(axis=0), th.mean(axis=0))
+            np.testing.assert_allclose(rho, float(d[f"rho_cross_{method}"]),
+                                       atol=1e-9)
+            assert rho > 0.85, (method, rho)

@@ -58,6 +58,14 @@ def test_monitor_duty_cycle_probe(tmp_path):
     assert duties, "duty probes produced no samples"
     assert all(0.0 <= d <= 1.0 for d in duties)
     assert "probe_ms" in recs[0] and "probe_base_ms" in recs[0]
+    # Duty is PER DEVICE (one probe/baseline per local device, reference
+    # logged per-GPU util — ddp_new.py:37-39): every device entry carries its
+    # own duty fields, on all 8 forced-CPU mesh devices.
+    dev_entries = recs[0]["devices"]
+    assert len(dev_entries) == len(jax.local_devices())
+    for d in dev_entries:
+        assert 0.0 <= d["duty_cycle"] <= 1.0
+        assert d["probe_base_ms"] > 0.0
 
 
 @requires_mpl

@@ -7,8 +7,10 @@ scores must agree to float tolerance — far beyond the ρ ≥ 0.98 bar — so a
 (BatchNorm eval semantics, padding geometry, softmax precision) is caught exactly.
 
 The oracle reproduces the reference's INTENDED semantics (eval-mode inference — the
-reference accidentally scored in train mode, SURVEY §2.4.1). The torch models here are
-written from the standard architecture definitions, not copied from the reference.
+reference accidentally scored in train mode, SURVEY §2.4.1). The torch models live in
+``oracle/`` (shared with the independently-trained parity experiment,
+``tools/cross_framework_parity.py``) and are written from the standard architecture
+definitions, not copied from the reference.
 """
 
 import jax
@@ -17,8 +19,9 @@ import numpy as np
 import pytest
 
 torch = pytest.importorskip("torch")  # oracle only; suite must survive without it
-import torch.nn as tnn  # noqa: E402
-import torch.nn.functional as F  # noqa: E402
+
+from oracle import (TorchResNet18, TorchTinyCNN, port_flax_to_torch,  # noqa: E402
+                    torch_el2n, torch_grand)
 
 from data_diet_distributed_tpu.utils.stats import spearman
 from data_diet_distributed_tpu.models import create_model
@@ -26,132 +29,6 @@ from data_diet_distributed_tpu.ops.scores import (make_el2n_step, make_grand_ste
                                                   make_score_step)
 
 torch.manual_seed(0)
-
-
-# ---------------------------------------------------------------------------
-# Torch mirrors with flax-matching module names (so weight porting is mechanical).
-# ---------------------------------------------------------------------------
-
-class TorchTinyCNN(tnn.Module):
-    def __init__(self, num_classes=10, width=16):
-        super().__init__()
-        chans = [3, width, width * 2]
-        for i in range(2):
-            self.add_module(f"Conv_{i}", tnn.Conv2d(chans[i], chans[i + 1], 3,
-                                                    stride=2, padding=1, bias=False))
-            self.add_module(f"BatchNorm_{i}", tnn.BatchNorm2d(chans[i + 1],
-                                                              momentum=0.1, eps=1e-5))
-        self.classifier = tnn.Linear(width * 2, num_classes)
-
-    def forward(self, x):
-        for i in range(2):
-            x = getattr(self, f"Conv_{i}")(x)
-            x = getattr(self, f"BatchNorm_{i}")(x)
-            x = F.relu(x)
-        x = x.mean(dim=(2, 3))
-        return self.classifier(x)
-
-
-class TorchBasicBlock(tnn.Module):
-    def __init__(self, c_in, filters, stride):
-        super().__init__()
-        self.Conv_0 = tnn.Conv2d(c_in, filters, 3, stride=stride, padding=1,
-                                 bias=False)
-        self.BatchNorm_0 = tnn.BatchNorm2d(filters, eps=1e-5)
-        self.Conv_1 = tnn.Conv2d(filters, filters, 3, padding=1, bias=False)
-        self.BatchNorm_1 = tnn.BatchNorm2d(filters, eps=1e-5)
-        self.has_proj = stride != 1 or c_in != filters
-        if self.has_proj:
-            self.proj_conv = tnn.Conv2d(c_in, filters, 1, stride=stride, bias=False)
-            self.proj_norm = tnn.BatchNorm2d(filters, eps=1e-5)
-
-    def forward(self, x):
-        y = F.relu(self.BatchNorm_0(self.Conv_0(x)))
-        y = self.BatchNorm_1(self.Conv_1(y))
-        r = self.proj_norm(self.proj_conv(x)) if self.has_proj else x
-        return F.relu(r + y)
-
-
-class TorchResNet18(tnn.Module):
-    def __init__(self, num_classes=10, width=64):
-        super().__init__()
-        self.stem_conv = tnn.Conv2d(3, width, 3, padding=1, bias=False)
-        self.stem_norm = tnn.BatchNorm2d(width, eps=1e-5)
-        c_in, i = width, 0
-        for stage, blocks in enumerate([2, 2, 2, 2]):
-            filters = width * (2 ** stage)
-            for b in range(blocks):
-                stride = 2 if stage > 0 and b == 0 else 1
-                self.add_module(f"BasicBlock_{i}",
-                                TorchBasicBlock(c_in, filters, stride))
-                c_in = filters
-                i += 1
-        self.n_blocks = i
-        self.classifier = tnn.Linear(c_in, num_classes)
-
-    def forward(self, x):
-        x = F.relu(self.stem_norm(self.stem_conv(x)))
-        for i in range(self.n_blocks):
-            x = getattr(self, f"BasicBlock_{i}")(x)
-        x = x.mean(dim=(2, 3))
-        return self.classifier(x)
-
-
-# ---------------------------------------------------------------------------
-# Weight porting: flax pytree -> torch state_dict via shared naming.
-# ---------------------------------------------------------------------------
-
-def port_flax_to_torch(variables, torch_model):
-    flat_params = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
-    flat_stats = jax.tree_util.tree_flatten_with_path(variables["batch_stats"])[0]
-    sd = {}
-
-    def key_of(path):
-        return ".".join(p.key for p in path[:-1])
-
-    for path, value in flat_params:
-        key, leaf = key_of(path), path[-1].key
-        value = np.asarray(value)
-        if leaf == "kernel" and value.ndim == 4:      # HWIO -> OIHW
-            sd[f"{key}.weight"] = torch.tensor(value.transpose(3, 2, 0, 1))
-        elif leaf == "kernel":                        # dense: IO -> OI
-            sd[f"{key}.weight"] = torch.tensor(value.T)
-        elif leaf == "scale":
-            sd[f"{key}.weight"] = torch.tensor(value)
-        elif leaf == "bias":
-            sd[f"{key}.bias"] = torch.tensor(value)
-        else:
-            raise KeyError(f"unmapped param leaf {leaf}")
-    for path, value in flat_stats:
-        key, leaf = key_of(path), path[-1].key
-        name = {"mean": "running_mean", "var": "running_var"}[leaf]
-        sd[f"{key}.{name}"] = torch.tensor(np.asarray(value))
-    missing, unexpected = torch_model.load_state_dict(sd, strict=False)
-    assert not unexpected, unexpected
-    assert all("num_batches_tracked" in m for m in missing), missing
-    torch_model.eval()
-    return torch_model
-
-
-def torch_el2n(model, x_nchw, y):
-    with torch.no_grad():
-        logits = model(x_nchw)
-        probs = F.softmax(logits, dim=1)
-        onehot = F.one_hot(y, logits.shape[1]).float()
-        return (probs - onehot).norm(dim=1, p=2).numpy()
-
-
-def torch_grand(model, x_nchw, y):
-    out = []
-    for i in range(len(y)):
-        model.zero_grad(set_to_none=True)
-        loss = F.cross_entropy(model(x_nchw[i:i + 1]), y[i:i + 1])
-        loss.backward()
-        sq = sum(float((p.grad ** 2).sum()) for p in model.parameters()
-                 if p.grad is not None)
-        out.append(np.sqrt(sq))
-    return np.asarray(out)
-
 
 
 def _random_inputs(n, seed=0):

@@ -77,8 +77,7 @@ def test_scores_match_torch_oracle_on_real_data(real_run):
     import jax
 
     from data_diet_distributed_tpu.utils.stats import spearman
-    from tests.test_parity_torch import (TorchResNet18, port_flax_to_torch,
-                                         torch_el2n)
+    from oracle import TorchResNet18, port_flax_to_torch, torch_el2n
 
     _, sub, res, model, scores, tmp = real_run
     n = 512

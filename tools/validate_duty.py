@@ -1,0 +1,86 @@
+"""On-chip sanity check for the per-device duty-cycle probes (VERDICT r3 #5).
+
+Drives the production ``ResourceMonitor`` against a controlled load pattern on
+the real device: ~3 s idle, ~6 s of saturating dispatch (chained matmuls), ~3 s
+idle again — then reports the mean duty cycle the monitor recorded in each
+phase. A healthy probe reads ~0.0 idle and ~1.0 saturated; the busy/idle
+threshold (3x idle baseline) is thereby validated against an actual saturated
+workload, not just the CPU-backend unit test.
+
+Run (one TPU-attached process at a time!):
+  python tools/validate_duty.py [--out /tmp/duty_validation.json]
+Prints one JSON line; paste the numbers into PERFORMANCE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--idle-s", type=float, default=3.0)
+    parser.add_argument("--busy-s", type=float, default=6.0)
+    parser.add_argument("--dim", type=int, default=4096,
+                        help="matmul size for the saturating load")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from data_diet_distributed_tpu.obs.monitor import ResourceMonitor
+
+    path = tempfile.mktemp(suffix=".jsonl")
+    t_start = time.time()
+    with ResourceMonitor(path, interval_s=0.5):
+        time.sleep(args.idle_s)
+        t_busy0 = time.time()
+        x = jnp.ones((args.dim, args.dim), jnp.bfloat16)
+        f = jax.jit(lambda x: x @ x * 0.5 + 1.0)
+        x = f(x)                     # compile outside the timed window
+        float(jnp.sum(x.astype(jnp.float32)))
+        t_busy0 = time.time()
+        while time.time() - t_busy0 < args.busy_s:
+            x = f(x)
+        # Fetch-sync: the queue drains here, inside the busy window's tail.
+        float(jnp.sum(x.astype(jnp.float32)))
+        t_busy1 = time.time()
+        time.sleep(args.idle_s)
+    t_end = time.time()
+
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    os.unlink(path)
+
+    def phase_duty(lo, hi):
+        vals = [r["duty_cycle"] for r in recs
+                if "duty_cycle" in r and lo <= r["ts"] <= hi]
+        return round(sum(vals) / len(vals), 3) if vals else None
+
+    result = {
+        "n_samples": len(recs),
+        "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "duty_idle_pre": phase_duty(t_start, t_busy0 - 0.5),
+        "duty_busy": phase_duty(t_busy0 + 0.5, t_busy1 - 0.5),
+        "duty_idle_post": phase_duty(t_busy1 + 1.0, t_end),
+        "per_device_busy": [
+            d.get("duty_cycle") for r in recs for d in r.get("devices", [])
+            if t_busy0 + 0.5 <= r["ts"] <= t_busy1 - 0.5][:8],
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
