@@ -127,7 +127,19 @@ def main() -> None:
     parser.add_argument("--probe-timeout", type=float, default=150.0)
     parser.add_argument("--no-probe", action="store_true",
                         help="skip the subprocess backend probe (CI/CPU runs)")
+    parser.add_argument("--num-processes", type=int, default=1,
+                        help="multi-process run: launch one bench.py per "
+                             "process with matching --process-id; see "
+                             "PERFORMANCE.md for the 2-process CPU recipe")
+    parser.add_argument("--process-id", type=int, default=0)
+    parser.add_argument("--coordinator", default="localhost:12399")
     args = parser.parse_args()
+
+    if args.num_processes > 1:
+        # Multi-process rendezvous must happen before any backend init, so the
+        # in-process probe is skipped (use the CPU recipe's forced devices, or
+        # a real multi-host TPU slice where each host owns its chips).
+        args.no_probe = True
 
     metric = (f"{args.method}_scoring_examples_per_sec_per_chip"
               if args.task == "score" else "train_examples_per_sec_per_chip")
@@ -140,6 +152,11 @@ def main() -> None:
             return
 
     try:
+        if args.num_processes > 1:
+            import jax
+            jax.distributed.initialize(coordinator_address=args.coordinator,
+                                       num_processes=args.num_processes,
+                                       process_id=args.process_id)
         if args.task == "train":
             bench_train(args, metric)
         else:

@@ -101,13 +101,43 @@ class BatchAssembler:
         self._img_buf: np.ndarray | None = None
 
     def assemble(self, images: np.ndarray, labels: np.ndarray,
-                 indices: np.ndarray, take: np.ndarray, batch_size: int):
+                 indices: np.ndarray, take: np.ndarray, batch_size: int,
+                 norm: tuple[np.ndarray, np.ndarray] | None = None):
         n_take = len(take)
         row_shape = images.shape[1:]
         lib = load()
 
         mask = np.zeros(batch_size, np.float32)
         mask[:n_take] = 1.0
+
+        if norm is not None:
+            # Lazy dataset (possibly disk-backed memmap): gather the batch rows
+            # and normalize in the same pass. Only batch rows ever materialize
+            # normalized — the point of the mmap ingestion path. uint8 rows
+            # rescale to [0,1] first (fused into the native gather); float32
+            # rows normalize in their own units (same contract as the dense
+            # npz path).
+            mean, std = norm
+            rows_padded = _pad_rows(take, batch_size)
+            if images.dtype == np.uint8:
+                image = gather_normalize_u8(
+                    images, np.ascontiguousarray(take, np.int64), mean, std,
+                    batch_size)
+                if image is None:     # no native lib: numpy fallback
+                    image = ((np.asarray(images[rows_padded], np.float32)
+                              / 255.0 - mean) / std)
+            elif images.dtype == np.float32:
+                image = (np.asarray(images[rows_padded], np.float32) - mean) / std
+            else:
+                raise ValueError(
+                    f"lazy normalization expects uint8/float32 images, "
+                    f"got {images.dtype}")
+            label = np.asarray(labels[rows_padded], np.int32).copy()
+            index = np.asarray(indices[rows_padded], np.int32).copy()
+            if n_take < batch_size:
+                label[n_take:] = 0
+                index[n_take:] = 0
+            return image, label, index, mask
 
         if lib is not None and images.dtype == np.float32:
             if (not self.reuse or self._img_buf is None
@@ -135,6 +165,11 @@ class BatchAssembler:
             label[n_take:] = 0
             index[n_take:] = 0
         return image, label, index, mask
+
+
+def _pad_rows(take: np.ndarray, batch_size: int) -> np.ndarray:
+    pad = batch_size - len(take)
+    return np.concatenate([take, np.zeros(pad, np.int64)]) if pad else take
 
 
 def gather_normalize_u8(images_u8: np.ndarray, take: np.ndarray,

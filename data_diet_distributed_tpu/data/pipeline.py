@@ -51,7 +51,8 @@ def iterate_batches(ds: ArrayDataset, batch_size: int, *, shuffle: bool = False,
         take = order[start:start + batch_size]
         n_out = batch_size if pad_to_full else len(take)
         image, label, index, mask = asm.assemble(
-            ds.images, ds.labels, ds.indices, take.astype(np.int64), n_out)
+            ds.images, ds.labels, ds.indices, take.astype(np.int64), n_out,
+            norm=ds.norm)
         yield {"image": image, "label": label, "index": index, "mask": mask}
 
 
@@ -150,6 +151,7 @@ class ResidentBatches:
 
         if jax.process_count() > 1:
             raise ValueError("ResidentBatches is single-process only")
+        ds = ds.dense()   # lazy (mmap) datasets materialize normalized rows
         self.n = len(ds)
         self.batch_size = batch_size
         replicated = NamedSharding(mesh, P())

@@ -82,8 +82,10 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         n_dev = sharder.mesh.size if sharder is not None else 1
         budget = min(n_dev * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
                      _DEVICE_RESIDENT_MAX_BYTES)
+        # Size the decision by the UPLOADED footprint (batches materialize as
+        # float32 even when the dataset is lazy uint8/mmap on disk).
         device_resident = (len(variables_seeds) > 1
-                           and ds.images.nbytes <= budget)
+                           and ds.images.size * 4 <= budget)
 
     def device_batches():
         for host_batch in iterate_batches(ds, batch_size, shuffle=False):

@@ -176,3 +176,195 @@ def test_maybe_resident_gating(mesh8):
         assert pipeline.maybe_resident(ds, mesh8, 32, enabled=True) is not None
     finally:
         pipeline.RESIDENT_MAX_BYTES = old
+
+
+# ---------------------------------------------------------------------------
+# Memory-mapped .npy ingestion (ImageNet-scale path, VERDICT r3 next #4)
+# ---------------------------------------------------------------------------
+
+def _write_npz_dataset(tmp_path, n=256, hw=8, num_classes=5, seed=7):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    for split, rows in (("train", n), ("test", max(n // 4, 8))):
+        np.savez(tmp_path / f"{split}.npz",
+                 images=rng.integers(0, 256, (rows, hw, hw, 3), dtype=np.uint8),
+                 labels=rng.integers(0, num_classes, rows).astype(np.int64))
+
+
+def _convert_to_npy(tmp_path):
+    import subprocess
+    import sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "tools" / "npz_to_npy.py"),
+         "--data-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-800:]
+
+
+def test_npy_mmap_ingestion_matches_dense_npz(tmp_path):
+    """The mmap path must be byte-equivalent to the dense npz path: same
+    normalization, same batches, same scores-input — only the residency
+    differs."""
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import iterate_batches
+
+    _write_npz_dataset(tmp_path)
+    dense_train, dense_test = load_dataset("npz", str(tmp_path))
+    assert dense_train.norm is None
+
+    _convert_to_npy(tmp_path)
+    lazy_train, lazy_test = load_dataset("npz", str(tmp_path))
+    assert lazy_train.norm is not None
+    assert lazy_train.images.dtype == np.uint8
+    # Disk-backed: the images array is a memmap, not a RAM copy.
+    assert isinstance(lazy_train.images, np.memmap)
+    assert lazy_train.num_classes == dense_train.num_classes
+
+    for dense_ds, lazy_ds in ((dense_train, lazy_train),
+                              (dense_test, lazy_test)):
+        db = list(iterate_batches(dense_ds, 96))
+        lb = list(iterate_batches(lazy_ds, 96))
+        assert len(db) == len(lb)
+        for a, b in zip(db, lb):
+            np.testing.assert_allclose(a["image"], b["image"], rtol=1e-6,
+                                       atol=1e-6)
+            np.testing.assert_array_equal(a["label"], b["label"])
+            np.testing.assert_array_equal(a["index"], b["index"])
+            np.testing.assert_array_equal(a["mask"], b["mask"])
+
+
+def test_npy_mmap_subset_and_scoring_equivalence(tmp_path, mesh8):
+    """Pruning-style subsetting and the production scoring driver work on the
+    lazy dataset and agree with the dense path."""
+    import jax
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.ops.scoring import score_dataset
+
+    _write_npz_dataset(tmp_path)
+    # The loader prefers .npy splits when present, so load the dense variant
+    # BEFORE converting.
+    dense_train, _ = load_dataset("npz", str(tmp_path))
+    assert dense_train.norm is None
+    _convert_to_npy(tmp_path)
+    lazy_train, _ = load_dataset("npz", str(tmp_path))
+    assert lazy_train.norm is not None
+
+    keep = lazy_train.indices[::3]
+    sub = lazy_train.subset(keep)
+    assert sub.norm is not None and len(sub) == len(keep)
+    np.testing.assert_allclose(sub.dense().images,
+                               dense_train.subset(keep).images, atol=1e-6)
+
+    model = create_model("tiny_cnn", lazy_train.num_classes)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0), np.zeros((1, 8, 8, 3), np.float32), train=False)
+    sharder = BatchSharder(mesh8)
+    kw = dict(method="el2n", batch_size=64, sharder=sharder)
+    s_lazy = score_dataset(model, [variables], lazy_train,
+                           device_resident=False, **kw)
+    s_dense = score_dataset(model, [variables], dense_train,
+                            device_resident=False, **kw)
+    np.testing.assert_allclose(s_lazy, s_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_npy_mmap_streaming_bounded_memory(tmp_path):
+    """A dataset larger than the subprocess's ANONYMOUS-memory budget streams
+    through full batch iteration: only batch buffers are heap-allocated; the
+    images stay file-backed (RLIMIT_DATA does not count file-backed mmaps, so
+    the dense float32 path — 4x the on-disk bytes in heap — would blow the
+    limit this passes under)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    import numpy as np
+
+    n, hw = 8192, 32            # 8192*32*32*3 = 24 MiB uint8, 96 MiB as f32
+    rng = np.random.default_rng(0)
+    img = np.lib.format.open_memmap(tmp_path / "train_images.npy", mode="w+",
+                                    dtype=np.uint8, shape=(n, hw, hw, 3))
+    for i in range(0, n, 1024):
+        img[i:i + 1024] = rng.integers(0, 256, (1024, hw, hw, 3), np.uint8)
+    img.flush()
+    del img
+    np.save(tmp_path / "train_labels.npy", rng.integers(0, 10, n).astype(np.int32))
+    np.save(tmp_path / "test_images.npy",
+            rng.integers(0, 256, (64, hw, hw, 3), np.uint8))
+    np.save(tmp_path / "test_labels.npy", rng.integers(0, 10, 64).astype(np.int32))
+    np.savez(tmp_path / "stats.npz", mean=np.full(3, 0.5, np.float32),
+             std=np.full(3, 0.25, np.float32))
+
+    script = f"""
+import resource, sys
+# Anonymous-memory budget far below the dataset's float32 footprint (96 MiB)
+# plus far below even one full uint8 copy + float32 copy; numpy/python base
+# heap needs ~45 MiB.
+resource.setrlimit(resource.RLIMIT_DATA, (80 << 20, 80 << 20))
+import numpy as np
+from data_diet_distributed_tpu.data.datasets import load_dataset
+from data_diet_distributed_tpu.data.pipeline import iterate_batches
+train, _ = load_dataset("npz", {str(tmp_path)!r})
+assert train.norm is not None and isinstance(train.images, np.memmap)
+total = 0.0
+rows = 0
+for b in iterate_batches(train, 256):
+    total += float(b["image"].sum())
+    rows += int(b["mask"].sum())
+assert rows == {n}, rows
+print("OK", rows, round(total, 2))
+"""
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                          text=True, timeout=300, cwd=repo)
+    assert proc.returncode == 0, (proc.stdout[-300:], proc.stderr[-1500:])
+    assert proc.stdout.startswith("OK")
+
+
+def test_npy_mmap_float32_explicit_stats(tmp_path):
+    """float32 images with explicit mean/std must normalize identically through
+    the dense npz path and the converted mmap path (review r4: the stats were
+    silently dropped for float32)."""
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import iterate_batches
+
+    rng = np.random.default_rng(3)
+    mean = np.array([0.1, -0.2, 0.3], np.float32)
+    std = np.array([1.5, 0.5, 2.0], np.float32)
+    for split, rows in (("train", 128), ("test", 32)):
+        np.savez(tmp_path / f"{split}.npz",
+                 images=rng.normal(size=(rows, 8, 8, 3)).astype(np.float32),
+                 labels=rng.integers(0, 4, rows).astype(np.int64),
+                 **({"mean": mean, "std": std} if split == "train" else {}))
+    dense_train, _ = load_dataset("npz", str(tmp_path))
+    _convert_to_npy(tmp_path)
+    lazy_train, _ = load_dataset("npz", str(tmp_path))
+    assert lazy_train.norm is not None and lazy_train.images.dtype == np.float32
+    a = next(iterate_batches(dense_train, 64))
+    b = next(iterate_batches(lazy_train, 64))
+    np.testing.assert_allclose(a["image"], b["image"], rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(lazy_train.dense().images, dense_train.images,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_npy_mmap_staleness_guard(tmp_path):
+    """Regenerating the npz source after conversion must refuse loudly, not
+    silently serve the stale converted arrays."""
+    import os
+    import time
+    import numpy as np
+    import pytest
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+
+    _write_npz_dataset(tmp_path, n=64)
+    _convert_to_npy(tmp_path)
+    load_dataset("npz", str(tmp_path))   # fresh: loads fine
+    future = time.time() + 10
+    os.utime(tmp_path / "train.npz", (future, future))
+    with pytest.raises(ValueError, match="newer than its converted"):
+        load_dataset("npz", str(tmp_path))
