@@ -66,13 +66,15 @@ class OptimConfig:
 class ScoreConfig:
     """Per-example scoring pass (reference: ``get_scores_and_prune.py``)."""
 
-    # el2n | margin | grand | grand_vmap | grand_last_layer | forgetting.
+    # el2n | margin | grand | grand_vmap | grand_last_layer | forgetting | aum.
     # "grand" is full-parameter GraNd via the batched exact algorithm
     # (ops/grand_batched.py) in eval mode; "grand_vmap" forces the naive
     # vmap(grad) path (cross-checks, exotic layers); "margin" is the
     # uncertainty-margin baseline max_{k≠y} p_k − p_y (higher = harder);
     # "forgetting" counts forgetting events across score.pretrain_epochs of
-    # training (Toneva et al. 2019, ops/forgetting.py).
+    # training (Toneva et al. 2019, ops/forgetting.py); "aum" averages the
+    # probability margin across the same trajectory (area-under-margin,
+    # Pleiss et al. 2020, sign-flipped so higher = harder).
     method: str = "el2n"
     # Which checkpoint feeds the scoring pass. The reference hard-codes epoch 19
     # (train.py:61, ddp.py:72); here it is a knob.
@@ -185,15 +187,17 @@ class Config:
                 raise ValueError(
                     f"prune.sweep entries must be in (0, 1), got {s}")
         if self.score.method not in ("el2n", "margin", "grand", "grand_vmap",
-                                     "grand_last_layer", "forgetting"):
+                                     "grand_last_layer", "forgetting", "aum"):
             raise ValueError(f"unknown score method {self.score.method!r}")
-        if self.score.method == "forgetting" and self.score.pretrain_epochs < 1:
-            raise ValueError("score.method=forgetting tracks correctness across "
-                             "training epochs; set score.pretrain_epochs >= 1")
-        if self.score.method == "forgetting" and self.score.score_ckpt_step is not None:
+        if (self.score.method in ("forgetting", "aum")
+                and self.score.pretrain_epochs < 1):
+            raise ValueError(f"score.method={self.score.method} tracks the "
+                             "training trajectory; set score.pretrain_epochs >= 1")
+        if (self.score.method in ("forgetting", "aum")
+                and self.score.score_ckpt_step is not None):
             raise ValueError(
-                "score.method=forgetting scores a training TRAJECTORY and "
-                "cannot start from score.score_ckpt_step; unset one of them")
+                f"score.method={self.score.method} scores a training TRAJECTORY "
+                "and cannot start from score.score_ckpt_step; unset one of them")
         if self.model.stem not in ("cifar", "imagenet"):
             raise ValueError(f"unknown stem {self.model.stem!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):

@@ -100,23 +100,17 @@ class BatchAssembler:
         self.reuse = reuse
         self._img_buf: np.ndarray | None = None
 
-    def assemble(self, images: np.ndarray, labels: np.ndarray,
-                 indices: np.ndarray, take: np.ndarray, batch_size: int,
-                 norm: tuple[np.ndarray, np.ndarray] | None = None):
+    def assemble_images(self, images: np.ndarray, take: np.ndarray,
+                        batch_size: int,
+                        norm: tuple[np.ndarray, np.ndarray] | None = None
+                        ) -> np.ndarray:
+        """Image-only gather+pad (+lazy normalize) — the per-host slice path:
+        under a multi-host runtime each process assembles only its contiguous
+        slice of the global batch's images (labels/indices/mask are trivial
+        host-side arrays and stay global for the score join)."""
         n_take = len(take)
-        row_shape = images.shape[1:]
         lib = load()
-
-        mask = np.zeros(batch_size, np.float32)
-        mask[:n_take] = 1.0
-
         if norm is not None:
-            # Lazy dataset (possibly disk-backed memmap): gather the batch rows
-            # and normalize in the same pass. Only batch rows ever materialize
-            # normalized — the point of the mmap ingestion path. uint8 rows
-            # rescale to [0,1] first (fused into the native gather); float32
-            # rows normalize in their own units (same contract as the dense
-            # npz path).
             mean, std = norm
             rows_padded = _pad_rows(take, batch_size)
             if images.dtype == np.uint8:
@@ -126,44 +120,48 @@ class BatchAssembler:
                 if image is None:     # no native lib: numpy fallback
                     image = ((np.asarray(images[rows_padded], np.float32)
                               / 255.0 - mean) / std)
-            elif images.dtype == np.float32:
-                image = (np.asarray(images[rows_padded], np.float32) - mean) / std
-            else:
-                raise ValueError(
-                    f"lazy normalization expects uint8/float32 images, "
-                    f"got {images.dtype}")
+                return image
+            if images.dtype == np.float32:
+                return (np.asarray(images[rows_padded], np.float32) - mean) / std
+            raise ValueError(
+                f"lazy normalization expects uint8/float32 images, "
+                f"got {images.dtype}")
+        row_shape = images.shape[1:]
+        if lib is not None and images.dtype == np.float32:
+            if (not self.reuse or self._img_buf is None
+                    or self._img_buf.shape != (batch_size, *row_shape)):
+                self._img_buf = np.empty((batch_size, *row_shape), np.float32)
+            lib.dd_gather_f32(images, int(np.prod(row_shape)),
+                              np.ascontiguousarray(take, np.int64), n_take,
+                              batch_size, self._img_buf)
+            return self._img_buf
+        return images[_pad_rows(take, batch_size)]
+
+    def assemble(self, images: np.ndarray, labels: np.ndarray,
+                 indices: np.ndarray, take: np.ndarray, batch_size: int,
+                 norm: tuple[np.ndarray, np.ndarray] | None = None):
+        n_take = len(take)
+        lib = load()
+
+        mask = np.zeros(batch_size, np.float32)
+        mask[:n_take] = 1.0
+        image = self.assemble_images(images, take, batch_size, norm)
+
+        if lib is not None:
+            rows = np.ascontiguousarray(take, np.int64)
+            label = np.empty(batch_size, np.int32)
+            index = np.empty(batch_size, np.int32)
+            lib.dd_gather_i32(np.ascontiguousarray(labels, np.int32), rows,
+                              n_take, batch_size, label)
+            lib.dd_gather_i32(np.ascontiguousarray(indices, np.int32), rows,
+                              n_take, batch_size, index)
+        else:
+            rows_padded = _pad_rows(take, batch_size)
             label = np.asarray(labels[rows_padded], np.int32).copy()
             index = np.asarray(indices[rows_padded], np.int32).copy()
             if n_take < batch_size:
                 label[n_take:] = 0
                 index[n_take:] = 0
-            return image, label, index, mask
-
-        if lib is not None and images.dtype == np.float32:
-            if (not self.reuse or self._img_buf is None
-                    or self._img_buf.shape != (batch_size, *row_shape)):
-                self._img_buf = np.empty((batch_size, *row_shape), np.float32)
-            rows = np.ascontiguousarray(take, np.int64)
-            row_elems = int(np.prod(row_shape))
-            lib.dd_gather_f32(images, row_elems, rows, n_take, batch_size,
-                              self._img_buf)
-            label_out = np.empty(batch_size, np.int32)
-            index_out = np.empty(batch_size, np.int32)
-            lib.dd_gather_i32(np.ascontiguousarray(labels, np.int32), rows,
-                              n_take, batch_size, label_out)
-            lib.dd_gather_i32(np.ascontiguousarray(indices, np.int32), rows,
-                              n_take, batch_size, index_out)
-            return self._img_buf, label_out, index_out, mask
-
-        # NumPy fallback (and the reference implementation for tests).
-        pad = batch_size - n_take
-        full = np.concatenate([take, np.zeros(pad, np.int64)]) if pad else take
-        image = images[full]
-        label = labels[full].copy()
-        index = indices[full].copy()
-        if pad:
-            label[n_take:] = 0
-            index[n_take:] = 0
         return image, label, index, mask
 
 

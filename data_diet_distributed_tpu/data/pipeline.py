@@ -35,24 +35,53 @@ def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
 
 def iterate_batches(ds: ArrayDataset, batch_size: int, *, shuffle: bool = False,
                     seed: int = 0, epoch: int = 0, pad_to_full: bool = True,
-                    assembler: "BatchAssembler | None" = None) -> Iterator[Batch]:
+                    assembler: "BatchAssembler | None" = None,
+                    image_slice: tuple[int, int] | None = None) -> Iterator[Batch]:
     """Yield padded, masked global batches as host numpy dicts.
 
     The final partial batch is padded by repeating row 0 with ``mask=0``; reductions
     must multiply by ``mask`` (all built-in steps here do). Assembly (gather + pad)
     goes through the native C++ engine when available (``data/native.py``), with a
     NumPy fallback.
+
+    ``image_slice=(p, P)``: assemble only the ``p``-th of ``P`` contiguous
+    row-slices of each batch's IMAGES — the multi-host ingestion path: each
+    process gathers (and, for lazy datasets, reads from disk and normalizes)
+    only the rows it will feed its own devices, instead of assembling the full
+    global batch and discarding ``(P-1)/P`` of it. Labels/index/mask stay
+    global (they are bytes, and the scoring join needs them host-side). The
+    slice boundaries match ``BatchSharder``'s per-process split exactly.
     """
     from .native import BatchAssembler
     asm = assembler or BatchAssembler()
     n = len(ds)
     order = epoch_permutation(n, seed, epoch) if shuffle else np.arange(n)
     for start in range(0, n, batch_size):
-        take = order[start:start + batch_size]
+        take = order[start:start + batch_size].astype(np.int64)
         n_out = batch_size if pad_to_full else len(take)
-        image, label, index, mask = asm.assemble(
-            ds.images, ds.labels, ds.indices, take.astype(np.int64), n_out,
-            norm=ds.norm)
+        if image_slice is None:
+            image, label, index, mask = asm.assemble(
+                ds.images, ds.labels, ds.indices, take, n_out, norm=ds.norm)
+        else:
+            p, nprocs = image_slice
+            if n_out % nprocs:
+                raise ValueError(
+                    f"batch of {n_out} rows does not divide over {nprocs} "
+                    "processes; use global_batch_size_for")
+            loc = n_out // nprocs
+            # Global (tiny) arrays via a zero-image assemble would still gather
+            # images; do them directly (ONE padding convention: _pad_rows).
+            from .native import _pad_rows
+            mask = np.zeros(n_out, np.float32)
+            mask[:len(take)] = 1.0
+            full = _pad_rows(take, n_out)
+            label = np.asarray(ds.labels[full], np.int32).copy()
+            index = np.asarray(ds.indices[full], np.int32).copy()
+            if len(take) < n_out:
+                label[len(take):] = 0
+                index[len(take):] = 0
+            take_local = take[p * loc:min((p + 1) * loc, len(take))]
+            image = asm.assemble_images(ds.images, take_local, loc, norm=ds.norm)
         yield {"image": image, "label": label, "index": index, "mask": mask}
 
 
@@ -90,11 +119,21 @@ class BatchSharder:
         devices score distinct examples (params re-replicate once per pass)."""
         return cls(mesh, axes=tuple(mesh.axis_names))
 
-    def __call__(self, batch: Batch) -> dict[str, jax.Array]:
+    def __call__(self, batch: Batch,
+                 images_local: bool = False) -> dict[str, jax.Array]:
+        """Place a host batch on the mesh. ``images_local``: the ``image``
+        entry holds only THIS process's contiguous row-slice (assembled via
+        ``iterate_batches(..., image_slice=...)``); other entries are global.
+        """
         out = {}
         nprocs = jax.process_count()
         for key, value in batch.items():
             if nprocs > 1:
+                if images_local and key == "image":
+                    global_shape = (value.shape[0] * nprocs, *value.shape[1:])
+                    out[key] = jax.make_array_from_process_local_data(
+                        self.sharding, np.asarray(value), global_shape)
+                    continue
                 # Unequal slices would silently mis-shard (device d would get
                 # rows meant for d±1); global_batch_size_for rounds to nprocs
                 # divisibility, so anything else here is a caller bug.
@@ -117,6 +156,28 @@ class BatchSharder:
         nprocs = jax.process_count()
         div = int(div * nprocs // np.gcd(div, nprocs))   # lcm
         return ((requested + div - 1) // div) * div
+
+
+def device_stream(ds: ArrayDataset, batch_size: int, sharder: BatchSharder, *,
+                  shuffle: bool = False, seed: int = 0, epoch: int = 0,
+                  assembler: "BatchAssembler | None" = None):
+    """The production streaming path: host batches assembled and placed on the
+    mesh, with per-process image assembly under a multi-host runtime (each
+    host gathers/reads/normalizes only its slice of every global batch —
+    the TPU-scale version of per-rank sampling, vs the reference's
+    DistributedSampler over a fully-materialized dataset, ``ddp.py:127-130``).
+
+    Yields ``(host_batch, device_batch)`` — ``host_batch`` keeps the global
+    ``index``/``mask`` for score joins; its ``image`` entry is the local slice
+    under multihost (callers that need global host images should not be
+    streaming multihost).
+    """
+    nprocs = jax.process_count()
+    image_slice = (jax.process_index(), nprocs) if nprocs > 1 else None
+    for hb in iterate_batches(ds, batch_size, shuffle=shuffle, seed=seed,
+                              epoch=epoch, assembler=assembler,
+                              image_slice=image_slice):
+        yield hb, sharder(hb, images_local=image_slice is not None)
 
 
 # Auto device-residency cap for ResidentBatches: the arrays are replicated per

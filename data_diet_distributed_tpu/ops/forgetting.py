@@ -52,3 +52,35 @@ class ForgettingTracker:
         out = self.counts.astype(np.float32)
         out[~self.learned] = float(self.updates + 1)
         return out
+
+
+class AUMTracker:
+    """Average probability margin across the training trajectory — the
+    area-under-the-margin identification score (Pleiss et al. 2020, "Identifying
+    Mislabeled Data using the Area Under the Margin Ranking"), accumulated from
+    the same per-epoch observations as ``ForgettingTracker``.
+
+    Sign convention matches the framework's one-checkpoint ``margin`` method
+    (``ops/scores.margin_from_logits``): each observation is
+    ``max_{k≠y} p_k − p_y``, so HIGHER average = harder/likely-mislabeled and
+    keep-hardest pruning composes unchanged. (The paper's logit-margin AUM is
+    this quantity's sign-flip in logit space; the probability form keeps one
+    margin definition across the framework.)
+    """
+
+    def __init__(self, n: int):
+        self.total = np.zeros(n, np.float64)
+        self.updates = 0
+
+    def update(self, margin: np.ndarray) -> None:
+        margin = np.asarray(margin, np.float64)
+        if margin.shape != self.total.shape:
+            raise ValueError(
+                f"margin vector has shape {margin.shape}, expected "
+                f"{self.total.shape}")
+        self.total += margin
+        self.updates += 1
+
+    def scores(self) -> np.ndarray:
+        """[N] float32 — mean margin over the observed epochs."""
+        return (self.total / max(1, self.updates)).astype(np.float32)

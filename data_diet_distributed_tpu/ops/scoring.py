@@ -16,7 +16,7 @@ import jax
 import numpy as np
 
 from ..data.datasets import ArrayDataset, make_position_joiner
-from ..data.pipeline import BatchSharder, iterate_batches
+from ..data.pipeline import BatchSharder, device_stream, iterate_batches
 from .scores import make_score_step
 
 
@@ -88,9 +88,15 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                            and ds.images.size * 4 <= budget)
 
     def device_batches():
+        if sharder is not None:
+            # Production path: per-process image assembly under multihost (the
+            # global index/mask stay host-side for the score join below).
+            for host_batch, batch in device_stream(ds, batch_size, sharder):
+                yield (host_batch["index"], host_batch["mask"].astype(bool),
+                       batch)
+            return
         for host_batch in iterate_batches(ds, batch_size, shuffle=False):
-            batch = sharder(host_batch) if sharder is not None else {
-                k: jax.numpy.asarray(v) for k, v in host_batch.items()}
+            batch = {k: jax.numpy.asarray(v) for k, v in host_batch.items()}
             yield (host_batch["index"], host_batch["mask"].astype(bool), batch)
 
     resident = list(device_batches()) if device_resident else None

@@ -29,8 +29,8 @@ import numpy as np
 from ..checkpoint import CheckpointManager
 from ..config import Config
 from ..data.datasets import ArrayDataset
-from ..data.pipeline import (BatchSharder, iterate_batches, maybe_resident,
-                             num_batches)
+from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
+                             maybe_resident, num_batches)
 from ..models import create_model
 from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
@@ -90,8 +90,7 @@ def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
             "rebuild the ResidentBatches or pass the matching size")
     totals = {"loss_sum": 0.0, "correct": 0.0, "examples": 0.0}
     batches = (resident() if resident is not None else
-               (sharder(hb) for hb in iterate_batches(ds, batch_size,
-                                                      shuffle=False)))
+               (db for _, db in device_stream(ds, batch_size, sharder)))
     # Dispatch ahead, fetch in bounded windows: one host round trip per window
     # (per-scalar float() syncs are ruinous on high-latency device transports)
     # without pinning every streamed batch in HBM at once (resident batches live
@@ -222,8 +221,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         batches = (train_resident(shuffle=shuffle, seed=cfg.train.seed,
                                   epoch=epoch)
                    if train_resident is not None else
-                   (sharder(hb) for hb in iterate_batches(
-                       train_ds, batch_size, shuffle=shuffle,
+                   (db for _, db in device_stream(
+                       train_ds, batch_size, sharder, shuffle=shuffle,
                        seed=cfg.train.seed, epoch=epoch)))
         # Device scalars accumulate un-synced (async dispatch); host conversion
         # happens once per epoch below, in a single device_get — per-scalar
@@ -389,24 +388,27 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     return out
 
 
-def forgetting_scores(cfg: Config, train_ds: ArrayDataset, *,
+def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
                       mesh, sharder, logger) -> np.ndarray:
-    """Forgetting-events scores (Toneva et al. 2019; ``ops/forgetting.py``).
+    """Trajectory scores: forgetting events (Toneva et al. 2019) or
+    area-under-margin (Pleiss et al. 2020) — ``ops/forgetting.py``.
 
     Per seed: train a fresh model for ``score.pretrain_epochs`` epochs and,
-    after each epoch, run a mesh-sharded correctness pass over the train set in
+    after each epoch, run a mesh-sharded per-example pass over the train set in
     dataset order (reusing the training's device-resident upload when present);
-    the tracker counts correct→incorrect transitions on the host. Scores are
-    the per-seed mean. Unlike EL2N/GraNd this score is a property of a training
+    the tracker accumulates on the host (correct→incorrect transition counts
+    for ``forgetting``; running mean margin for ``aum``). Scores are the
+    per-seed mean. Unlike EL2N/GraNd these scores are a property of a training
     TRAJECTORY, not of one checkpoint — hence the fit-with-hook structure
     instead of ``score_dataset``.
     """
+    method = cfg.score.method
     if cfg.score.pretrain_epochs < 1:
         raise ValueError(
-            "score.method=forgetting tracks correctness across training "
-            "epochs; set score.pretrain_epochs >= 1")
-    from ..ops.scores import make_correctness_step
-    from ..ops.forgetting import ForgettingTracker
+            f"score.method={method} tracks the training trajectory; set "
+            "score.pretrain_epochs >= 1")
+    from ..ops.scores import make_correctness_step, make_margin_step
+    from ..ops.forgetting import AUMTracker, ForgettingTracker
     from ..ops.scoring import _to_host
 
     model = create_model(cfg.model.arch, cfg.model.num_classes,
@@ -416,19 +418,29 @@ def forgetting_scores(cfg: Config, train_ds: ArrayDataset, *,
     # TP-placed state.variables, and sharding propagation partitions the
     # forward exactly as train/eval do. The flattened-mesh shard_map layout
     # belongs to score_dataset's re-sharded pipeline, not to this hook.
-    step = make_correctness_step(model, None, eval_mode=cfg.score.eval_mode)
+    if method == "forgetting":
+        step = make_correctness_step(model, None, eval_mode=cfg.score.eval_mode)
+        make_tracker, to_obs = ForgettingTracker, lambda v: v > 0.5
+    elif method == "aum":
+        step = make_margin_step(model, None, eval_mode=cfg.score.eval_mode)
+        make_tracker, to_obs = AUMTracker, lambda v: v
+    else:
+        # The forgetting_scores back-compat alias must not silently return
+        # AUM scores when a caller passes a cfg configured for another method.
+        raise ValueError(
+            f"trajectory_scores handles forgetting/aum, got {method!r}")
     n = len(train_ds)
     batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
     shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
     total = np.zeros(n, np.float64)
     for s in cfg.score.seeds:
-        tracker = ForgettingTracker(n)
+        tracker = make_tracker(n)
 
         def hook(model_, state, epoch, tracker=tracker):
             batches = (shared_resident(shuffle=False)
                        if shared_resident is not None else
-                       (sharder(hb) for hb in iterate_batches(
-                           train_ds, batch_size, shuffle=False)))
+                       (db for _, db in device_stream(
+                           train_ds, batch_size, sharder)))
             # Bounded dispatch window in streaming mode so queued uploads
             # can't pin every batch in HBM (same pattern as evaluate /
             # score_dataset); resident batches live on device -> one flush.
@@ -445,18 +457,25 @@ def forgetting_scores(cfg: Config, train_ds: ArrayDataset, *,
                 if len(pending) >= window:
                     flush()
             flush()
-            tracker.update(np.concatenate(chunks)[:n] > 0.5)
+            tracker.update(to_obs(np.concatenate(chunks)[:n]))
 
         fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
             num_epochs=cfg.score.pretrain_epochs, seed=int(s),
-            tag=f"forgetting_seed{s}", train_resident=shared_resident,
+            tag=f"{method}_seed{s}", train_resident=shared_resident,
             epoch_hook=hook)
-        logger.log("forgetting_seed_done", seed=int(s),
-                   epochs=tracker.updates,
-                   never_learned=int((~tracker.learned).sum()),
-                   mean_events=float(tracker.counts.mean()))
+        rec = {"seed": int(s), "epochs": tracker.updates}
+        if method == "forgetting":
+            rec.update(never_learned=int((~tracker.learned).sum()),
+                       mean_events=float(tracker.counts.mean()))
+        else:
+            rec.update(mean_margin=float(tracker.scores().mean()))
+        logger.log(f"{method}_seed_done", **rec)
         total += tracker.scores()
     return (total / len(cfg.score.seeds)).astype(np.float32)
+
+
+# Back-compat name (tests/multihost_worker.py and external callers).
+forgetting_scores = trajectory_scores
 
 
 def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
@@ -471,8 +490,8 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
     scoring pass, so the whole wall lands in ``score_s``.
     """
     t0 = time.perf_counter()
-    if cfg.score.method == "forgetting":
-        scores = forgetting_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
+    if cfg.score.method in ("forgetting", "aum"):
+        scores = trajectory_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
                                    logger=logger)
         return scores, {"pretrain_s": 0.0, "score_s": time.perf_counter() - t0}
     seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,

@@ -90,6 +90,18 @@ forget = forgetting_scores(cfg_f, train_ds, mesh=mesh, sharder=sharder,
 print(f"forgetting: mean={forget.mean():.2f} events, "
       f"never-learned={(forget > cfg_f.score.pretrain_epochs).sum()}")
 
+# %% AUM (Pleiss et al. 2020) rides the same trajectory hook: the mean
+# probability margin across training epochs (higher = harder/mislabeled-ish).
+from data_diet_distributed_tpu.train.loop import trajectory_scores
+
+cfg_a = copy.deepcopy(cfg)
+cfg_a.score.method = "aum"
+cfg_a.score.pretrain_epochs = 2
+aum = trajectory_scores(cfg_a, train_ds, mesh=mesh, sharder=sharder,
+                        logger=MetricsLogger(None, echo=False))
+print(f"aum: mean margin={aum.mean():+.3f}, "
+      f"spearman(AUM, GraNd)={spearman(aum, grand):.3f}")
+
 # %% The whole pipeline above is one config-driven call (or `datadiet run ...`);
 # a sparsity sweep shares one scoring pass across levels (`datadiet sweep ...`):
 # from data_diet_distributed_tpu.train.loop import run_datadiet, run_sweep

@@ -368,3 +368,42 @@ def test_npy_mmap_staleness_guard(tmp_path):
     os.utime(tmp_path / "train.npz", (future, future))
     with pytest.raises(ValueError, match="newer than its converted"):
         load_dataset("npz", str(tmp_path))
+
+
+def test_image_slice_assembly_matches_full():
+    """Per-process image assembly (multihost ingestion): the P contiguous
+    slices concatenate to exactly the full-assembly batch, for eager AND lazy
+    datasets, including the padded tail batch."""
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import iterate_batches
+
+    ds, _ = load_dataset("synthetic", synthetic_size=100, seed=1)  # 100 % 32 != 0
+    for P in (2, 4):
+        full = list(iterate_batches(ds, 32, shuffle=True, seed=3, epoch=1))
+        sliced = [list(iterate_batches(ds, 32, shuffle=True, seed=3, epoch=1,
+                                       image_slice=(p, P))) for p in range(P)]
+        for b, fb in enumerate(full):
+            glued = np.concatenate([sliced[p][b]["image"] for p in range(P)])
+            np.testing.assert_array_equal(glued, fb["image"])
+            for p in range(P):   # label/index/mask stay global in every slice
+                np.testing.assert_array_equal(sliced[p][b]["label"], fb["label"])
+                np.testing.assert_array_equal(sliced[p][b]["index"], fb["index"])
+                np.testing.assert_array_equal(sliced[p][b]["mask"], fb["mask"])
+
+
+def test_image_slice_assembly_lazy(tmp_path):
+    import numpy as np
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import iterate_batches
+
+    _write_npz_dataset(tmp_path, n=70)
+    _convert_to_npy(tmp_path)
+    ds, _ = load_dataset("npz", str(tmp_path))
+    assert ds.norm is not None
+    full = list(iterate_batches(ds, 32))
+    sliced = [list(iterate_batches(ds, 32, image_slice=(p, 2)))
+              for p in range(2)]
+    for b, fb in enumerate(full):
+        glued = np.concatenate([sliced[p][b]["image"] for p in range(2)])
+        np.testing.assert_allclose(glued, fb["image"], rtol=1e-6, atol=1e-6)
