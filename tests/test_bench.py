@@ -81,3 +81,23 @@ def test_probe_backend_success(monkeypatch):
                         lambda cmd, **kw: FakeProc())
     info = bench.probe_backend(attempts=1, timeout_s=1.0)
     assert info == {"n": 1, "platform": "tpu"}
+
+
+def test_bench_northstar_smoke():
+    """--task northstar runs the production score_dataset workload and emits
+    wall seconds with a workload-scaled vs_baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--task", "northstar",
+         "--size", "128", "--seeds", "2", "--batch", "64",
+         "--arch", "tiny_cnn", "--chunk", "8", "--no-probe"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "grand_northstar_wall_s"
+    assert line["unit"] == "seconds" and line["value"] > 0
+    assert line["size"] == 128 and line["seeds"] == 2
+    # Budget scaling: ratio uses 60 s x (size*seeds)/(50k*10), not raw 60/wall
+    # (value is rounded to 4 decimals, so compare with relative tolerance).
+    budget = 60.0 * 128 * 2 / (50_000 * 10)
+    assert abs(line["vs_baseline"] - budget / line["value"])         <= 0.05 * line["vs_baseline"] + 1e-6
