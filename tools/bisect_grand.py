@@ -6,12 +6,20 @@ This measures the REAL production pass — the same program the driver's bench
 runs — rather than a rewrapped loop, because full-pass compiles through the
 relay are slow enough that per-combination jit variants are impractical.
 
-Run: python tools/bisect_grand.py [--size N] [--batch B]
+``--fast`` runs the curated four-config race (baseline, the two expected
+winners, and their composition) instead of the full matrix — ~10 min on a
+healthy chip vs ~45. Results also land as JSON in ``--out`` (default
+``bisect_results.json``) with the winner marked, and the run ABORTS after the
+first combination whose bench reports a backend ``"error"`` (a dead relay
+fails in one bounded probe instead of timing out per combo).
+
+Run: python tools/bisect_grand.py [--fast] [--size N] [--batch B]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -20,34 +28,77 @@ COMBOS = [
     ("baseline", {}),
     ("catdot", {"DDT_GRAND_CATDOT": "1"}),
     ("bn_kernel", {"DDT_GRAND_BN_KERNEL": "1"}),
+    ("bn_kernel+catdot", {"DDT_GRAND_BN_KERNEL": "1",
+                          "DDT_GRAND_CATDOT": "1"}),
     ("bn_kernel+group_bn", {"DDT_GRAND_BN_KERNEL": "1",
                             "DDT_GRAND_GROUP_BN": "1"}),
     ("group_conv", {"DDT_GRAND_GROUP_CONV": "1"}),
     ("stem_xla", {"DDT_GRAND_STEM_XLA": "1"}),
+    ("bn_kernel+catdot+stem_xla", {"DDT_GRAND_BN_KERNEL": "1",
+                                   "DDT_GRAND_CATDOT": "1",
+                                   "DDT_GRAND_STEM_XLA": "1"}),
 ]
+
+FAST = ("baseline", "bn_kernel", "catdot", "bn_kernel+catdot")
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=8192)
     ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--chunk", type=int, default=64)
     ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--fast", action="store_true",
+                    help="curated 4-config race (expected winners only)")
+    ap.add_argument("--out", default="bisect_results.json")
     args = ap.parse_args()
     bench = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench.py")
-    for name, env in COMBOS:
+    combos = [(n, e) for n, e in COMBOS if not args.fast or n in FAST]
+    results = []
+    for name, env in combos:
         cmd = [sys.executable, bench, "--size", str(args.size),
-               "--batch", str(args.batch)]
+               "--batch", str(args.batch), "--arch", args.arch,
+               "--chunk", str(args.chunk)]
         try:
             out = subprocess.run(
                 cmd, env={**os.environ, **env}, capture_output=True,
                 text=True, timeout=args.timeout)
             lines = [ln for ln in out.stdout.splitlines()
                      if ln.startswith("{")]
-            print(f"{name:20s}: {lines[-1] if lines else out.stderr[-200:]}",
+            rec = {"combo": name, "env": env}
+            if lines:
+                try:
+                    rec.update(json.loads(lines[-1]))
+                except ValueError:
+                    rec["error"] = f"unparseable bench output: {lines[-1][:300]}"
+            else:
+                rec["error"] = out.stderr[-300:]
+            print(f"{name:28s}: {lines[-1] if lines else rec['error']}",
                   flush=True)
         except subprocess.TimeoutExpired:
-            print(f"{name:20s}: TIMEOUT", flush=True)
+            rec = {"combo": name, "env": env, "error": "TIMEOUT"}
+            print(f"{name:28s}: TIMEOUT", flush=True)
+        results.append(rec)
+        # Abort ONLY for backend-unavailable failures (a dead/wedged relay
+        # fails every combo identically — one bounded failure is the signal).
+        # A combo-specific crash or a slow compile TIMEOUT must not skip the
+        # rest of the matrix and misdeclare a winner from a partial set.
+        if "backend" in str(rec.get("error", "")):
+            print(f"aborting: backend unavailable ({name!r})", flush=True)
+            break
+    ok = [r for r in results if not r.get("error") and r.get("value")]
+    winner = max(ok, key=lambda r: r["value"]) if ok else None
+    payload = {"results": results,
+               "measured": len(ok), "requested": len(combos),
+               "winner": winner["combo"] if winner else None,
+               "winner_env": winner["env"] if winner else None}
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    print(json.dumps({"winner": payload["winner"],
+                      "winner_env": payload["winner_env"],
+                      "out": args.out}), flush=True)
 
 
 if __name__ == "__main__":
