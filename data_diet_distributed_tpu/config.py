@@ -29,6 +29,15 @@ class DataConfig:
     eval_batch_size: int = 500        # reference hardcodes 100 (data/loader.py:41)
     synthetic_size: int = 2048        # train-set size for the synthetic datasets
     shuffle_each_epoch: bool = True   # reference bug 2.4.6: DDP reshuffle never happened
+    # On-device training augmentation (random crop + flip inside the jitted
+    # train step — data/augment.py). The reference trains un-augmented
+    # (data/loader.py:8-11), so the default preserves its semantics.
+    augment: bool = False
+    crop_pad: int = 4                 # random-crop padding when augment=true
+    # Horizontal flip as part of augment=true. Off for orientation-sensitive
+    # datasets (digits/characters via the npz path) where mirroring changes
+    # example semantics.
+    flip: bool = True
 
     @property
     def num_classes(self) -> int | None:
@@ -46,6 +55,10 @@ class ModelConfig:
     # ResNet input geometry: "cifar" (3x3/s1 stem, no pool — the reference's,
     # models/resnet.py:71-73) or "imagenet" (7x7/s2 + 3x3/s2 max-pool).
     stem: str = "cifar"
+    # Rematerialize block activations in backward passes (jax.checkpoint):
+    # ~1 extra forward of FLOPs for O(depth) less activation HBM — for deep
+    # models / big batches. Parameter trees are identical either way.
+    remat: bool = False
 
 
 @dataclass

@@ -26,21 +26,30 @@ _REGISTRY = {
 
 
 def create_model(arch: str, num_classes: int, half_precision: bool = False,
-                 stem: str = "cifar"):
+                 stem: str = "cifar", remat: bool = False):
     """Instantiate a model by name. ``half_precision`` selects bfloat16 compute
     (fp32 params) — the TPU-native mixed-precision recipe. ``stem`` picks the
     ResNet input geometry: "cifar" (3x3/s1, the reference's) or "imagenet"
-    (7x7/s2 + max-pool, for the ImageNet-subset configs)."""
+    (7x7/s2 + max-pool, for the ImageNet-subset configs). ``remat``
+    rematerializes block activations in backward passes (activation HBM ->
+    FLOPs trade for deep models / large batches); parameter trees are
+    identical either way."""
     if arch not in _REGISTRY:
         raise ValueError(f"unknown arch {arch!r}; known: {sorted(_REGISTRY)}")
     dtype = jnp.bfloat16 if half_precision else jnp.float32
     factory = _REGISTRY[arch]
-    # Capability dispatch: a factory advertises stem support via its signature.
-    if "stem" in inspect.signature(factory).parameters:
-        return factory(num_classes=num_classes, dtype=dtype, stem=stem)
-    if stem != "cifar":
+    # Capability dispatch: a factory advertises support via its signature.
+    params = inspect.signature(factory).parameters
+    kwargs = {"num_classes": num_classes, "dtype": dtype}
+    if "stem" in params:
+        kwargs["stem"] = stem
+    elif stem != "cifar":
         raise ValueError(f"arch {arch!r} has no {stem!r} stem variant")
-    return factory(num_classes=num_classes, dtype=dtype)
+    if "remat" in params:
+        kwargs["remat"] = remat
+    elif remat:
+        raise ValueError(f"arch {arch!r} has no remat variant")
+    return factory(**kwargs)
 
 
 __all__ = [

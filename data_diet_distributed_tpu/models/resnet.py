@@ -107,6 +107,12 @@ class ResNet(nn.Module):
     # models/resnet.py:71-73). "imagenet": 7x7 stride-2 conv + 3x3 stride-2
     # max-pool — the standard large-image stem for the ImageNet-subset config.
     stem: str = "cifar"
+    # Rematerialize block activations in the backward pass (jax.checkpoint via
+    # nn.remat): trades ~1 extra forward of FLOPs for O(depth) less activation
+    # HBM — the TPU recipe for deep models / large batches. Block names are
+    # pinned explicitly so the parameter tree (and thus checkpoints and the
+    # torch-oracle weight port) is IDENTICAL with remat on or off.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False, capture_features: bool = False):
@@ -126,12 +132,16 @@ class ResNet(nn.Module):
             x = nn.relu(norm(name="stem_norm")(x))
         else:
             raise ValueError(f"unknown stem {self.stem!r} (cifar | imagenet)")
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        idx = 0
         for stage, num_blocks in enumerate(self.stage_sizes):
             filters = self.width * (2 ** stage)
             for block in range(num_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = self.block_cls(filters=filters, strides=strides,
-                                   conv=conv, norm=norm)(x)
+                x = block_cls(filters=filters, strides=strides,
+                              conv=conv, norm=norm,
+                              name=f"{self.block_cls.__name__}_{idx}")(x)
+                idx += 1
         x = jnp.mean(x, axis=(1, 2))            # global average pool (NHWC -> NC)
         features = x.astype(jnp.float32)
         logits = nn.Dense(self.num_classes, dtype=self.dtype,
@@ -142,26 +152,31 @@ class ResNet(nn.Module):
         return logits
 
 
-def ResNet18(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+def ResNet18(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar",
+         remat: bool = False) -> ResNet:
     return ResNet((2, 2, 2, 2), BasicBlock, num_classes=num_classes, dtype=dtype,
-                  stem=stem)
+                  stem=stem, remat=remat)
 
 
-def ResNet34(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+def ResNet34(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar",
+         remat: bool = False) -> ResNet:
     return ResNet((3, 4, 6, 3), BasicBlock, num_classes=num_classes, dtype=dtype,
-                  stem=stem)
+                  stem=stem, remat=remat)
 
 
-def ResNet50(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+def ResNet50(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar",
+         remat: bool = False) -> ResNet:
     return ResNet((3, 4, 6, 3), BottleneckBlock, num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, remat=remat)
 
 
-def ResNet101(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+def ResNet101(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar",
+         remat: bool = False) -> ResNet:
     return ResNet((3, 4, 23, 3), BottleneckBlock, num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, remat=remat)
 
 
-def ResNet152(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar") -> ResNet:
+def ResNet152(num_classes: int = 10, dtype=jnp.float32, stem: str = "cifar",
+         remat: bool = False) -> ResNet:
     return ResNet((3, 8, 36, 3), BottleneckBlock, num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, remat=remat)

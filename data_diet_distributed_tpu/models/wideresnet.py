@@ -48,6 +48,7 @@ class WideResNet(nn.Module):
     widen_factor: int = 10
     num_classes: int = 10
     dtype: Any = jnp.float32
+    remat: bool = False   # see ResNet.remat — same contract, same name pinning
 
     @nn.compact
     def __call__(self, x, *, train: bool = False, capture_features: bool = False):
@@ -62,10 +63,14 @@ class WideResNet(nn.Module):
 
         x = x.astype(self.dtype)
         x = conv(16, (3, 3), padding=PAD1, name="stem_conv")(x)
+        block_cls = nn.remat(WideBlock) if self.remat else WideBlock
+        idx = 0
         for stage, filters in enumerate((16 * k, 32 * k, 64 * k)):
             for block in range(n):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = WideBlock(filters=filters, strides=strides, conv=conv, norm=norm)(x)
+                x = block_cls(filters=filters, strides=strides, conv=conv,
+                              norm=norm, name=f"WideBlock_{idx}")(x)
+                idx += 1
         x = nn.relu(norm(name="final_norm")(x))
         x = jnp.mean(x, axis=(1, 2))
         features = x.astype(jnp.float32)
@@ -77,5 +82,7 @@ class WideResNet(nn.Module):
         return logits
 
 
-def WideResNet28_10(num_classes: int = 10, dtype=jnp.float32) -> WideResNet:
-    return WideResNet(depth=28, widen_factor=10, num_classes=num_classes, dtype=dtype)
+def WideResNet28_10(num_classes: int = 10, dtype=jnp.float32,
+                    remat: bool = False) -> WideResNet:
+    return WideResNet(depth=28, widen_factor=10, num_classes=num_classes,
+                      dtype=dtype, remat=remat)

@@ -133,7 +133,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
     steps_per_epoch = num_batches(len(train_ds), batch_size)
     model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem)
+                         cfg.train.half_precision, stem=cfg.model.stem,
+                         remat=cfg.model.remat)
     rng = jax.random.key(cfg.train.seed)
     state = create_train_state(cfg, rng, steps_per_epoch,
                                sample_shape=(1, *train_ds.images.shape[1:]))
@@ -183,7 +184,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     result = FitResult(state=state)
     t_start = time.perf_counter()
     try:
-        train_step = make_train_step(model)
+        augment = ((cfg.data.crop_pad, cfg.data.flip, cfg.train.seed)
+                   if cfg.data.augment else None)
+        train_step = make_train_step(model, augment)
         eval_step = make_eval_step(model) if test_ds is not None else None
 
         # Device-resident epoch data: upload the (pruned) train set — and the
@@ -380,7 +383,8 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
             out.append(res.state.variables)
         else:
             model = create_model(cfg.model.arch, cfg.model.num_classes,
-                                 cfg.train.half_precision, stem=cfg.model.stem)
+                                 cfg.train.half_precision, stem=cfg.model.stem,
+                                 remat=cfg.model.remat)
             variables = jax.jit(model.init, static_argnames=("train",))(
                 jax.random.key(int(s)),
                 np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
@@ -412,7 +416,8 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
     from ..ops.scoring import _to_host
 
     model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem)
+                         cfg.train.half_precision, stem=cfg.model.stem,
+                         remat=cfg.model.remat)
     # Plain jit (mesh=None -> no shard_map), like eval_step: the hook feeds
     # TRAINING-layout batches (data-axis sharded, train batch size) and
     # TP-placed state.variables, and sharding propagation partitions the
@@ -498,7 +503,8 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
                                            sharder=sharder, logger=logger)
     pretrain_s = time.perf_counter() - t0
     model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem)
+                         cfg.train.half_precision, stem=cfg.model.stem,
+                         remat=cfg.model.remat)
     t1 = time.perf_counter()
     scores = score_dataset(model, seeds_vars, train_ds,
                            method=cfg.score.method,

@@ -51,7 +51,8 @@ def create_train_state(cfg: Config, rng: jax.Array, steps_per_epoch: int,
     """Fresh model init + optimizer. The prune-then-retrain phase calls this again —
     the reference also retrains from scratch after pruning (``train.py:71``)."""
     model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem)
+                         cfg.train.half_precision, stem=cfg.model.stem,
+                         remat=cfg.model.remat)
     variables = jax.jit(model.init, static_argnames=("train",))(
         rng, jnp.zeros(sample_shape, jnp.float32), train=False)
     tx = make_optimizer(cfg, steps_per_epoch)

@@ -32,15 +32,23 @@ from .state import TrainState
 # functools.cache: Flax modules are frozen dataclasses (hashable by config), so the
 # same model config returns the SAME jitted step — repeated fits (multi-seed scoring
 # pretrains 10 models) hit the jit cache instead of recompiling per seed.
+# ``augment`` is a hashable (crop_pad, flip, seed) tuple (None = off) for the
+# same reason; the seed in the tuple means augmented multi-seed pretrains
+# recompile per seed — see data/augment.py for why that trade is taken.
 @functools.cache
-def make_train_step(model):
+def make_train_step(model, augment: tuple[int, bool, int] | None = None):
     def train_step(state: TrainState, batch):
         mask = batch["mask"]
+        image = batch["image"]
+        if augment is not None:
+            from ..data.augment import augment_images
+            image = augment_images(state.step, image, crop_pad=augment[0],
+                                   flip=augment[1], seed=augment[2])
 
         def loss_fn(params):
             logits, updates = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
-                batch["image"], train=True, mutable=["batch_stats"])
+                image, train=True, mutable=["batch_stats"])
             per_ex = cross_entropy(logits, batch["label"]) * mask
             loss = jnp.sum(per_ex) / jnp.maximum(jnp.sum(mask), 1.0)
             return loss, (logits, updates["batch_stats"])

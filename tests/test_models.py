@@ -100,3 +100,53 @@ def test_imagenet_stem_geometry():
     import pytest
     with pytest.raises(ValueError, match="stem"):
         create_model("wideresnet28_10", 10, stem="imagenet")
+
+
+def test_remat_identical_params_and_outputs():
+    """model.remat trades FLOPs for activation memory ONLY: parameter trees
+    (paths + shapes), forward outputs, and training gradients are identical
+    with remat on and off — so checkpoints and the torch weight port work
+    unchanged."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from data_diet_distributed_tpu.models import create_model
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, 4).astype(np.int32))
+    for arch in ("resnet18", "wideresnet28_10"):
+        plain = create_model(arch, 10)
+        rematd = create_model(arch, 10, remat=True)
+        v_plain = plain.init(jax.random.key(0), x[:1])
+        v_remat = rematd.init(jax.random.key(0), x[:1])
+        paths_a = [p for p, _ in jax.tree_util.tree_flatten_with_path(v_plain)[0]]
+        paths_b = [p for p, _ in jax.tree_util.tree_flatten_with_path(v_remat)[0]]
+        assert paths_a == paths_b   # name pinning: identical trees
+        for a, b in zip(jax.tree.leaves(v_plain), jax.tree.leaves(v_remat)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        out_a = plain.apply(v_plain, x, train=False)
+        out_b = rematd.apply(v_remat, x, train=False)
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                                   rtol=1e-6, atol=1e-6)
+
+        def loss(params, model, variables):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, 10)
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+        g_a = jax.grad(loss)(v_plain["params"], plain, v_plain)
+        g_b = jax.grad(loss)(v_remat["params"], rematd, v_remat)
+        for a, b in zip(jax.tree.leaves(g_a), jax.tree.leaves(g_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_remat_unsupported_arch_rejected():
+    import pytest
+    from data_diet_distributed_tpu.models import create_model
+    with pytest.raises(ValueError, match="remat"):
+        create_model("tiny_cnn", 10, remat=True)

@@ -140,3 +140,51 @@ def test_run_sweep_shares_one_scoring_pass(tmp_path):
         assert os.path.isdir(f"{tmp_path}/ck_{suffix}")
         data = np.load(f"{tmp_path}/ck_{suffix}_scores.npz")
         assert data["scores"].shape == (128,) and len(data["kept"]) == kept
+
+
+def test_augment_images_semantics():
+    """On-device augmentation: shape-preserving, deterministic per step,
+    different across steps, identity when disabled."""
+    import jax.numpy as jnp
+    from data_diet_distributed_tpu.data.augment import augment_images
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 32, 32, 3)).astype(np.float32))
+    a1 = np.asarray(augment_images(3, x))
+    a2 = np.asarray(augment_images(3, x))
+    a3 = np.asarray(augment_images(4, x))
+    assert a1.shape == x.shape
+    np.testing.assert_array_equal(a1, a2)          # deterministic per step
+    assert not np.array_equal(a1, a3)              # varies across steps
+    assert not np.array_equal(a1, np.asarray(x))   # actually augments
+    # flip+crop never invents values: every augmented pixel is either zero
+    # (crop border) or present in the source image's value multiset per row...
+    # cheap global check: value range is bounded by the source's.
+    assert a1.min() >= min(float(x.min()), 0.0) - 1e-6
+    assert a1.max() <= max(float(x.max()), 0.0) + 1e-6
+    # no-op config: flip off, no crop padding
+    np.testing.assert_array_equal(
+        np.asarray(augment_images(3, x, crop_pad=0, flip=False)), x)
+    # distinct training seeds get distinct augmentation streams even at the
+    # same step (review r4: key(0) alone collapsed multi-seed diversity when
+    # shuffle_each_epoch=false)
+    assert not np.array_equal(np.asarray(augment_images(3, x, seed=0)),
+                              np.asarray(augment_images(3, x, seed=1)))
+
+
+def test_fit_with_augmentation(tiny_cfg):
+    """data.augment=true trains through the jitted step (masked metrics stay
+    sane) and changes the training trajectory vs un-augmented."""
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.train.loop import fit
+
+    train_ds, _ = load_dataset("synthetic", synthetic_size=128, seed=0)
+    res_plain = fit(tiny_cfg, train_ds, None, num_epochs=1)
+    import copy
+    cfg_aug = copy.deepcopy(tiny_cfg)
+    cfg_aug.data.augment = True
+    res_aug = fit(cfg_aug, train_ds, None, num_epochs=1)
+    assert np.isfinite(res_aug.history[-1]["train_loss"])
+    a = np.asarray(res_plain.state.params["classifier"]["kernel"])
+    b = np.asarray(res_aug.state.params["classifier"]["kernel"])
+    assert not np.allclose(a, b)   # augmentation altered the trajectory
