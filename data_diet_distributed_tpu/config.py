@@ -211,6 +211,8 @@ class Config:
             raise ValueError(
                 f"score.method={self.score.method} scores a training TRAJECTORY "
                 "and cannot start from score.score_ckpt_step; unset one of them")
+        if self.data.crop_pad < 0:
+            raise ValueError(f"data.crop_pad must be >= 0, got {self.data.crop_pad}")
         if self.model.stem not in ("cifar", "imagenet"):
             raise ValueError(f"unknown stem {self.model.stem!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):

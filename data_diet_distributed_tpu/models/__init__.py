@@ -52,7 +52,17 @@ def create_model(arch: str, num_classes: int, half_precision: bool = False,
     return factory(**kwargs)
 
 
+def create_model_from_cfg(cfg):
+    """The ONE cfg->model mapping (arch, classes, precision, stem, remat) —
+    every cfg-driven site uses this so a new ModelConfig knob cannot be
+    threaded through some callers and silently dropped by others."""
+    return create_model(cfg.model.arch, cfg.model.num_classes,
+                        cfg.train.half_precision, stem=cfg.model.stem,
+                        remat=cfg.model.remat)
+
+
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
     "TinyCNN", "WideResNet", "WideResNet28_10", "create_model",
+    "create_model_from_cfg",
 ]

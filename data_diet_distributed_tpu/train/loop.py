@@ -31,7 +31,7 @@ from ..config import Config
 from ..data.datasets import ArrayDataset
 from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              maybe_resident, num_batches)
-from ..models import create_model
+from ..models import create_model_from_cfg
 from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
@@ -132,9 +132,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
     batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
     steps_per_epoch = num_batches(len(train_ds), batch_size)
-    model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem,
-                         remat=cfg.model.remat)
+    model = create_model_from_cfg(cfg)
     rng = jax.random.key(cfg.train.seed)
     state = create_train_state(cfg, rng, steps_per_epoch,
                                sample_shape=(1, *train_ds.images.shape[1:]))
@@ -382,9 +380,7 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
                       tag=f"score_pretrain_seed{s}", train_resident=shared_resident)
             out.append(res.state.variables)
         else:
-            model = create_model(cfg.model.arch, cfg.model.num_classes,
-                                 cfg.train.half_precision, stem=cfg.model.stem,
-                                 remat=cfg.model.remat)
+            model = create_model_from_cfg(cfg)
             variables = jax.jit(model.init, static_argnames=("train",))(
                 jax.random.key(int(s)),
                 np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
@@ -415,9 +411,7 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
     from ..ops.forgetting import AUMTracker, ForgettingTracker
     from ..ops.scoring import _to_host
 
-    model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem,
-                         remat=cfg.model.remat)
+    model = create_model_from_cfg(cfg)
     # Plain jit (mesh=None -> no shard_map), like eval_step: the hook feeds
     # TRAINING-layout batches (data-axis sharded, train batch size) and
     # TP-placed state.variables, and sharding propagation partitions the
@@ -502,9 +496,7 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
     seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
                                            sharder=sharder, logger=logger)
     pretrain_s = time.perf_counter() - t0
-    model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem,
-                         remat=cfg.model.remat)
+    model = create_model_from_cfg(cfg)
     t1 = time.perf_counter()
     scores = score_dataset(model, seeds_vars, train_ds,
                            method=cfg.score.method,

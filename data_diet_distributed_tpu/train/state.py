@@ -21,7 +21,7 @@ from flax import struct
 from flax.training import train_state
 
 from ..config import Config
-from ..models import create_model
+from ..models import create_model_from_cfg
 
 
 class TrainState(train_state.TrainState):
@@ -50,9 +50,7 @@ def create_train_state(cfg: Config, rng: jax.Array, steps_per_epoch: int,
                        sample_shape: tuple[int, ...] = (1, 32, 32, 3)) -> TrainState:
     """Fresh model init + optimizer. The prune-then-retrain phase calls this again —
     the reference also retrains from scratch after pruning (``train.py:71``)."""
-    model = create_model(cfg.model.arch, cfg.model.num_classes,
-                         cfg.train.half_precision, stem=cfg.model.stem,
-                         remat=cfg.model.remat)
+    model = create_model_from_cfg(cfg)
     variables = jax.jit(model.init, static_argnames=("train",))(
         rng, jnp.zeros(sample_shape, jnp.float32), train=False)
     tx = make_optimizer(cfg, steps_per_epoch)

@@ -42,3 +42,10 @@ def test_yaml_roundtrip(tmp_path):
     save_config(cfg, path)
     cfg2 = load_config(path, [])
     assert to_dict(cfg2) == to_dict(cfg)
+
+
+def test_crop_pad_validation():
+    import pytest
+    from data_diet_distributed_tpu.config import load_config
+    with pytest.raises(ValueError, match="crop_pad"):
+        load_config(None, ["data.crop_pad=-1"])

@@ -1,0 +1,56 @@
+"""tools/export_torch.py: framework checkpoint -> torch state_dict, with the
+exported model's outputs matching the framework's (the oracle weight-port
+transform the parity suite proves exact)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_export_roundtrip(tmp_path, tiny_cfg, tiny_ds):
+    from oracle import TorchTinyCNN
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.train.loop import fit
+
+    train_ds, _ = tiny_ds
+    tiny_cfg.train.checkpoint_every = 1
+    ckpt_dir = str(tmp_path / "ck")
+    res = fit(tiny_cfg, train_ds, None, num_epochs=1, checkpoint_dir=ckpt_dir)
+
+    out = tmp_path / "model.pt"
+    # CPU env: without it the subprocess would initialize the TPU backend
+    # (checkpoints are backend-agnostic; the export needs no accelerator).
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "export_torch.py"),
+         "--checkpoint-dir", ckpt_dir, "--arch", "tiny_cnn",
+         "--num-classes", "10", "--out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    info = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert info["step"] == int(res.state.step)
+
+    payload = torch.load(out, weights_only=False)
+    mirror = TorchTinyCNN(num_classes=10)
+    mirror.load_state_dict(payload["state_dict"])
+    mirror.eval()
+
+    x = np.asarray(train_ds.images[:16], np.float32)
+    model = create_model("tiny_cnn", 10)
+    jx_logits = np.asarray(model.apply(
+        jax.device_get(res.state.variables), x, train=False))
+    with torch.no_grad():
+        th_logits = mirror(torch.tensor(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(jx_logits, th_logits, rtol=1e-4, atol=1e-5)

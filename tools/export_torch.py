@@ -1,0 +1,75 @@
+"""Export a framework checkpoint to a PyTorch state_dict file.
+
+The interop escape hatch for adopters: score/train here, then load the weights
+in torch for downstream tooling (or to cross-validate against the reference's
+ecosystem). Reuses the oracle's weight-port mapping — the same transform the
+parity tests prove exact (``tests/test_parity_torch.py``), so the exported
+model's outputs match this framework's to float tolerance. Reference analogue:
+its checkpoints are torch-native (``trainer/trainer.py:62-71``); this tool
+closes the loop in the other direction.
+
+Run (CPU recipe is fine — checkpoints are backend-agnostic):
+  env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python tools/export_torch.py \
+      --checkpoint-dir ./checkpoints --arch resnet18 --num-classes 10 \
+      --out model_torch.pt [--step N]
+
+Writes ``{"state_dict", "arch", "num_classes", "step"}`` via ``torch.save``;
+load with ``TorchResNet18(...).load_state_dict(payload["state_dict"])`` (the
+mirror classes ship in ``oracle/``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MIRRORS = {"tiny_cnn": "TorchTinyCNN", "resnet18": "TorchResNet18"}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--step", type=int, default=None,
+                        help="checkpoint step (default: latest)")
+    parser.add_argument("--arch", default="resnet18", choices=sorted(MIRRORS))
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    import jax
+    import torch
+
+    import oracle
+    from data_diet_distributed_tpu.checkpoint import CheckpointManager
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.train.state import create_train_state
+
+    cfg = load_config(None, [f"model.arch={args.arch}",
+                             f"model.num_classes={args.num_classes}",
+                             "train.half_precision=false"])
+    template = create_train_state(cfg, jax.random.key(0), steps_per_epoch=1)
+    mngr = CheckpointManager(args.checkpoint_dir)
+    step = args.step if args.step is not None else mngr.latest_step()
+    variables = mngr.restore_variables(template, step)
+    mngr.close()
+
+    mirror = getattr(oracle, MIRRORS[args.arch])(num_classes=args.num_classes)
+    oracle.port_flax_to_torch(jax.device_get(variables), mirror)
+
+    payload = {"state_dict": mirror.state_dict(), "arch": args.arch,
+               "num_classes": args.num_classes, "step": int(step)}
+    torch.save(payload, args.out)
+    n_params = int(sum(np.prod(v.shape) for v in payload["state_dict"].values()))
+    print(json.dumps({"out": args.out, "arch": args.arch, "step": int(step),
+                      "tensors": len(payload["state_dict"]),
+                      "parameters": n_params}))
+
+
+if __name__ == "__main__":
+    main()
