@@ -72,6 +72,9 @@ class OptimConfig:
     # Cosine T_max in epochs; reference sets CosineAnnealingLR(T_max=num_epochs)
     # (train.py:77) but train_sparse.py uses 200 with 20 epochs (train_sparse.py:39-40).
     cosine_t_max_epochs: int | None = None  # None -> num_epochs
+    # Linear LR warmup epochs before the cosine (large-batch recipe; the
+    # reference has none, so 0 preserves its schedule).
+    warmup_epochs: int = 0
     grad_clip_norm: float | None = None
 
 
@@ -213,6 +216,15 @@ class Config:
                 "and cannot start from score.score_ckpt_step; unset one of them")
         if self.data.crop_pad < 0:
             raise ValueError(f"data.crop_pad must be >= 0, got {self.data.crop_pad}")
+        if self.optim.warmup_epochs < 0:
+            raise ValueError(
+                f"optim.warmup_epochs must be >= 0, got {self.optim.warmup_epochs}")
+        t_max = self.optim.cosine_t_max_epochs or self.train.num_epochs
+        if self.optim.warmup_epochs and self.optim.warmup_epochs >= t_max:
+            raise ValueError(
+                f"optim.warmup_epochs ({self.optim.warmup_epochs}) must be "
+                f"less than the cosine horizon ({t_max} epochs); raise "
+                "optim.cosine_t_max_epochs or lower the warmup")
         if self.model.stem not in ("cifar", "imagenet"):
             raise ValueError(f"unknown stem {self.model.stem!r}")
         if self.prune.keep not in ("hardest", "easiest", "random"):

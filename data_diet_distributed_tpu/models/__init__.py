@@ -53,9 +53,10 @@ def create_model(arch: str, num_classes: int, half_precision: bool = False,
 
 
 def create_model_from_cfg(cfg):
-    """The ONE cfg->model mapping (arch, classes, precision, stem, remat) —
-    every cfg-driven site uses this so a new ModelConfig knob cannot be
-    threaded through some callers and silently dropped by others."""
+    """The ONE cfg->model mapping (arch, classes, precision, stem, remat).
+    Every cfg-driven call site (package, examples, test harnesses) goes
+    through this so a new ModelConfig knob cannot be threaded through some
+    callers and silently dropped by others."""
     return create_model(cfg.model.arch, cfg.model.num_classes,
                         cfg.train.half_precision, stem=cfg.model.stem,
                         remat=cfg.model.remat)

@@ -32,11 +32,35 @@ class TrainState(train_state.TrainState):
         return {"params": self.params, "batch_stats": self.batch_stats}
 
 
-def make_optimizer(cfg: Config, steps_per_epoch: int) -> optax.GradientTransformation:
+def make_schedule(cfg: Config, steps_per_epoch: int) -> optax.Schedule:
+    """The LR schedule ``make_optimizer`` embeds (exposed so tests bind to the
+    production construction, not a hand-built copy)."""
     t_max_epochs = cfg.optim.cosine_t_max_epochs or cfg.train.num_epochs
-    schedule = optax.cosine_decay_schedule(
+    if cfg.optim.warmup_epochs > 0:
+        if cfg.optim.warmup_epochs >= t_max_epochs:
+            # Reachable even past config validation: fit() shortens num_epochs
+            # for scoring pretrains (_with_epochs), which can undercut a
+            # warmup meant for the long final training. optax's own failure is
+            # an opaque decay_steps=0 deep in the chain — refuse by name here.
+            raise ValueError(
+                f"optim.warmup_epochs ({cfg.optim.warmup_epochs}) >= cosine "
+                f"horizon ({t_max_epochs} epochs) for this fit; set "
+                "optim.cosine_t_max_epochs explicitly (it also fixes the "
+                "horizon for short scoring pretrains) or lower the warmup")
+        # Linear warmup into the cosine — the standard large-batch recipe
+        # (Goyal et al. 2017); the reference has no warmup, so default 0
+        # preserves its schedule exactly.
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.optim.lr,
+            warmup_steps=max(1, cfg.optim.warmup_epochs * steps_per_epoch),
+            decay_steps=max(1, t_max_epochs * steps_per_epoch))
+    return optax.cosine_decay_schedule(
         init_value=cfg.optim.lr,
         decay_steps=max(1, t_max_epochs * steps_per_epoch))
+
+
+def make_optimizer(cfg: Config, steps_per_epoch: int) -> optax.GradientTransformation:
+    schedule = make_schedule(cfg, steps_per_epoch)
     parts = []
     if cfg.optim.grad_clip_norm:
         parts.append(optax.clip_by_global_norm(cfg.optim.grad_clip_norm))

@@ -14,7 +14,7 @@
 # %% Setup: a mesh over every visible device, synthetic CIFAR-shaped data
 from data_diet_distributed_tpu.config import load_config
 from data_diet_distributed_tpu.data.pipeline import BatchSharder
-from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.models import create_model_from_cfg
 from data_diet_distributed_tpu.parallel.mesh import make_mesh
 from data_diet_distributed_tpu.train.loop import fit, load_data_for
 
@@ -39,7 +39,7 @@ print(f"pretrain: {result.history[-1]}")
 # sharded over the mesh (the reference scored on ONE GPU, ddp.py:56).
 from data_diet_distributed_tpu.ops.scoring import score_dataset
 
-model = create_model(cfg.model.arch, cfg.model.num_classes)
+model = create_model_from_cfg(cfg)
 variables = result.state.variables
 el2n = score_dataset(model, [variables], train_ds, method="el2n",
                      batch_size=256, sharder=sharder)

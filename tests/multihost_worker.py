@@ -38,7 +38,7 @@ def main() -> None:
     from data_diet_distributed_tpu.config import MeshConfig, load_config
     from data_diet_distributed_tpu.data.datasets import load_dataset
     from data_diet_distributed_tpu.data.pipeline import BatchSharder, maybe_resident
-    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.models import create_model_from_cfg
     from data_diet_distributed_tpu.ops.scoring import score_dataset
     from data_diet_distributed_tpu.parallel.mesh import (initialize_multihost,
                                                          is_primary, make_mesh,
@@ -103,7 +103,7 @@ def main() -> None:
 
     # Multi-seed scoring: _to_host takes the process_allgather branch; every
     # process ends up with the FULL score vector.
-    model = create_model(cfg.model.arch, cfg.model.num_classes)
+    model = create_model_from_cfg(cfg)
     variables = jax.jit(model.init, static_argnames=("train",))(
         jax.random.key(0), np.zeros((1, 32, 32, 3), np.float32), train=False)
     scores = score_dataset(model, [replicate(variables, mesh)], train_ds,

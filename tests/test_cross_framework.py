@@ -57,18 +57,24 @@ def test_independently_trained_rho_at_seed_noise_floor():
     assert rho_cross > rho_within - 0.1, (rho_cross, rho_within)
 
 
-def test_committed_artifact_is_self_consistent():
-    """The committed full-size artifact's recorded rhos must match a
-    recomputation from its own stored per-seed scores."""
-    path = REPO / "artifacts" / "cross_framework_parity.npz"
-    assert path.exists(), "full-size experiment artifact not committed"
+@pytest.mark.parametrize("name,min_seeds,floor", [
+    ("cross_framework_parity.npz", 3, 0.85),
+    # 10 seeds per side (the paper's count): averaged-score cross-framework
+    # rho clears the BASELINE 0.98 bar even for independently-trained runs.
+    ("cross_framework_parity_10seed.npz", 10, 0.98),
+])
+def test_committed_artifact_is_self_consistent(name, min_seeds, floor):
+    """The committed artifacts' recorded rhos must match a recomputation from
+    their own stored per-seed scores, above the expected floor."""
+    path = REPO / "artifacts" / name
+    assert path.exists(), f"experiment artifact {name} not committed"
     with np.load(path) as d:
         cfg = json.loads(str(d["config"]))
-        assert cfg["size"] >= 2048 and len(d["seeds"]) >= 3
+        assert cfg["size"] >= 2048 and len(d["seeds"]) >= min_seeds
         for method in cfg["methods"]:
             jx, th = d[f"jax_{method}"], d[f"torch_{method}"]
             assert jx.shape == th.shape == (len(d["seeds"]), cfg["size"])
             rho = spearman(jx.mean(axis=0), th.mean(axis=0))
             np.testing.assert_allclose(rho, float(d[f"rho_cross_{method}"]),
                                        atol=1e-9)
-            assert rho > 0.85, (method, rho)
+            assert rho > floor, (method, rho)

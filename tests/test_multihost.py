@@ -116,7 +116,7 @@ def test_multihost_matches_single_process(multihost_results, tmp_path):
     from data_diet_distributed_tpu.config import load_config
     from data_diet_distributed_tpu.data.datasets import load_dataset
     from data_diet_distributed_tpu.data.pipeline import BatchSharder
-    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.models import create_model_from_cfg
     from data_diet_distributed_tpu.ops.scoring import score_dataset
     from data_diet_distributed_tpu.parallel.mesh import make_mesh, replicate
     from data_diet_distributed_tpu.train.loop import fit
@@ -134,7 +134,7 @@ def test_multihost_matches_single_process(multihost_results, tmp_path):
     train_ds, test_ds = load_dataset("synthetic", synthetic_size=256, seed=0)
     res = fit(cfg, train_ds, test_ds, mesh=mesh, sharder=sharder)
 
-    model = create_model(cfg.model.arch, cfg.model.num_classes)
+    model = create_model_from_cfg(cfg)
     variables = jax.jit(model.init, static_argnames=("train",))(
         jax.random.key(0), np.zeros((1, 32, 32, 3), np.float32), train=False)
     scores = score_dataset(model, [replicate(variables, mesh)], train_ds,

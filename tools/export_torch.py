@@ -39,6 +39,12 @@ def main() -> None:
                         help="checkpoint step (default: latest)")
     parser.add_argument("--arch", default="resnet18", choices=sorted(MIRRORS))
     parser.add_argument("--num-classes", type=int, default=10)
+    # The torch mirrors are cifar-geometry; an imagenet-stem checkpoint has no
+    # mirror to port into, and a stem mismatch would otherwise surface as an
+    # opaque Orbax tree/shape error at restore — refuse up front instead.
+    parser.add_argument("--stem", default="cifar", choices=["cifar"],
+                        help="checkpoint stem geometry (only cifar-stem "
+                             "checkpoints have torch mirrors)")
     parser.add_argument("--out", required=True)
     args = parser.parse_args()
 
@@ -52,11 +58,18 @@ def main() -> None:
 
     cfg = load_config(None, [f"model.arch={args.arch}",
                              f"model.num_classes={args.num_classes}",
+                             f"model.stem={args.stem}",
                              "train.half_precision=false"])
     template = create_train_state(cfg, jax.random.key(0), steps_per_epoch=1)
     mngr = CheckpointManager(args.checkpoint_dir)
     step = args.step if args.step is not None else mngr.latest_step()
-    variables = mngr.restore_variables(template, step)
+    try:
+        variables = mngr.restore_variables(template, step)
+    except Exception as exc:
+        raise SystemExit(
+            f"restore failed ({type(exc).__name__}) — the checkpoint's model "
+            "config must match --arch/--num-classes/--stem exactly: "
+            f"{exc}") from exc
     mngr.close()
 
     mirror = getattr(oracle, MIRRORS[args.arch])(num_classes=args.num_classes)
