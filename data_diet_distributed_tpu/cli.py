@@ -112,7 +112,10 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
                                          sharder=sharder, logger=logger)
         out = scores_npz_path(cfg.train.checkpoint_dir)
         if is_primary():   # every process holds the full scores; one writes
-            np.savez(out, scores=scores, indices=train_ds.indices)
+            method = (f"reused:{score_t['loaded_from']}"
+                      if score_t.get("loaded_from") else cfg.score.method)
+            np.savez(out, scores=scores, indices=train_ds.indices,
+                     method=method)
         logger.log("scores_saved", path=out, n=len(scores),
                    mean=float(scores.mean()), std=float(scores.std()),
                    score_s=round(score_t["score_s"], 3),

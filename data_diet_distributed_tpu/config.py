@@ -106,6 +106,11 @@ class ScoreConfig:
     eval_mode: bool = True
     # Fused Pallas score kernels: None = auto (on for TPU backends, off elsewhere).
     use_pallas: bool | None = None
+    # Reuse previously-computed scores from a saved npz (as written by the
+    # run/score/sweep commands) instead of scoring: prune/retrain experiments
+    # then pay zero scoring cost. The npz's indices are joined to the dataset
+    # by global id, so subsets/reorderings are safe; a mismatch refuses loudly.
+    scores_npz: str | None = None
 
 
 @dataclass

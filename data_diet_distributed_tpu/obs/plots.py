@@ -75,12 +75,26 @@ def plot_utilization(monitor_path: str, out_dir: str = "./plots",
     written.append(path)
 
     # Device duty cycle (probe-latency busy fraction — obs/monitor._DutyProbe),
-    # the TPU stand-in for the reference's GPU utilization % (ddp_new.py:37-39).
+    # the TPU stand-in for the reference's per-GPU utilization %
+    # (ddp_new.py:37-39). One line PER DEVICE when the records carry
+    # per-device duty (monitors from round 4 on), plus the aggregate mean.
     duty = [(t, r["duty_cycle"]) for t, r in zip(times, records)
             if isinstance(r.get("duty_cycle"), (int, float))]
     if duty:
         fig, ax = plt.subplots(figsize=(8, 3))
-        ax.plot([p[0] for p in duty], [100.0 * p[1] for p in duty], lw=1.0)
+        per_dev: dict[str, list[tuple[float, float]]] = {}
+        for t, r in zip(times, records):
+            for d in r.get("devices", []):
+                if isinstance(d.get("duty_cycle"), (int, float)):
+                    per_dev.setdefault(d["device"], []).append(
+                        (t, d["duty_cycle"]))
+        for name, pts in sorted(per_dev.items()):
+            ax.plot([p[0] for p in pts], [100.0 * p[1] for p in pts],
+                    lw=0.8, alpha=0.6, label=name)
+        ax.plot([p[0] for p in duty], [100.0 * p[1] for p in duty], lw=1.4,
+                color="k", label="mean" if per_dev else None)
+        if per_dev and len(per_dev) <= 8:
+            ax.legend(fontsize=6, ncol=2)
         ax.set_xlabel("time (s)")
         ax.set_ylabel("device busy %")
         ax.set_ylim(0, 105)

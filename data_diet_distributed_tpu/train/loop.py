@@ -487,8 +487,19 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
     separated, so throughput reporting never folds multi-seed pretraining into
     the scoring rate. Forgetting is trajectory-based — its training IS the
     scoring pass, so the whole wall lands in ``score_s``.
+
+    ``score.scores_npz``: load scores from a saved artifact instead of
+    computing — prune/retrain experiments then pay zero scoring cost. The
+    npz's global indices are joined to the dataset's, so subsets and
+    reorderings are handled; missing examples refuse loudly.
     """
     t0 = time.perf_counter()
+    if cfg.score.scores_npz:
+        scores = load_scores_npz(cfg.score.scores_npz, train_ds)
+        logger.log("scores_loaded", path=cfg.score.scores_npz, n=len(scores))
+        return scores, {"pretrain_s": 0.0,
+                        "score_s": time.perf_counter() - t0,
+                        "loaded_from": cfg.score.scores_npz}
     if cfg.score.method in ("forgetting", "aum"):
         scores = trajectory_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
                                    logger=logger)
@@ -506,6 +517,27 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
                            use_pallas=cfg.score.use_pallas)
     return scores, {"pretrain_s": pretrain_s,
                     "score_s": time.perf_counter() - t1}
+
+
+def load_scores_npz(path: str, train_ds: ArrayDataset) -> np.ndarray:
+    """Scores from a saved artifact, re-joined to ``train_ds`` row order by
+    GLOBAL index (the artifact may cover a superset or a different ordering of
+    the dataset; any dataset example missing from the artifact refuses
+    loudly via the position joiner's KeyError)."""
+    from ..data.datasets import make_position_joiner
+
+    with np.load(path) as d:
+        if "scores" not in d or "indices" not in d:
+            raise ValueError(
+                f"{path} is not a scores artifact (needs 'scores' and "
+                "'indices' arrays, as written by the run/score/sweep commands)")
+        scores, indices = np.asarray(d["scores"]), np.asarray(d["indices"])
+    if scores.shape != indices.shape:
+        raise ValueError(
+            f"{path}: scores shape {scores.shape} does not match indices "
+            f"shape {indices.shape} — truncated or malformed artifact")
+    pos = make_position_joiner(indices)(train_ds.indices)
+    return scores[pos].astype(np.float32)
 
 
 def scores_npz_path(checkpoint_dir: str) -> str:
@@ -536,21 +568,30 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                           keep=cfg.prune.keep, seed=cfg.train.seed,
                           labels=train_ds.labels,
                           class_balance=cfg.prune.class_balance)
+    # Provenance: scores reused from an artifact did NOT come from this cfg's
+    # score.method — record where they came from instead.
+    loaded_from = score_t.get("loaded_from")
+    method = f"reused:{loaded_from}" if loaded_from else cfg.score.method
     if is_primary():   # every process holds the full scores; one writes
         np.savez(scores_npz_path(ckpt_dir), scores=scores,
                  indices=train_ds.indices, kept=kept, keep=cfg.prune.keep,
-                 class_balance=cfg.prune.class_balance)
+                 class_balance=cfg.prune.class_balance, method=method)
     score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
-    logger.log("prune", n_total=len(train_ds), n_kept=len(kept),
-               score_s=round(score_s, 3), pretrain_s=round(pretrain_s, 3),
-               score_examples_per_s=(len(train_ds) * _score_passes(cfg)
-                                     / score_s))
+    prune_rec = dict(n_total=len(train_ds), n_kept=len(kept),
+                     score_s=round(score_s, 3),
+                     pretrain_s=round(pretrain_s, 3))
+    if not loaded_from:
+        # An npz load in milliseconds is not a scoring rate — omit rather
+        # than log an absurd number.
+        prune_rec["score_examples_per_s"] = (
+            len(train_ds) * _score_passes(cfg) / score_s)
+    logger.log("prune", **prune_rec)
     res = fit_with_recovery(cfg, train_ds.subset(kept), test_ds, mesh=mesh,
                             sharder=sharder, logger=logger,
                             checkpoint_dir=ckpt_dir, tag=tag)
     summary = {
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
-        "sparsity": float(sparsity), "score_method": cfg.score.method,
+        "sparsity": float(sparsity), "score_method": method,
         "n_kept": len(kept), "score_wall_s": score_s,
         "pretrain_wall_s": pretrain_s,
         "final_test_accuracy": res.final_test_accuracy,

@@ -227,3 +227,41 @@ def test_warmup_config_validation():
         load_config(None, ["optim.warmup_epochs=-1"])
     with pytest.raises(ValueError, match="warmup_epochs"):
         load_config(None, ["optim.warmup_epochs=10", "train.num_epochs=10"])
+
+
+def test_scores_npz_reuse(tiny_cfg, tmp_path):
+    """score.scores_npz reuses a saved artifact: zero scoring cost, identical
+    kept set, index-joined so subsets/reordering are safe."""
+    import copy
+    from data_diet_distributed_tpu.train.loop import load_scores_npz
+
+    cfg = copy.deepcopy(tiny_cfg)
+    cfg.prune.sparsity = 0.5
+    cfg.score.pretrain_epochs = 0
+    cfg.train.num_epochs = 1
+    cfg.train.checkpoint_dir = str(tmp_path / "ck")
+    summary1 = run_datadiet(cfg)
+    npz = f"{cfg.train.checkpoint_dir}_scores.npz"
+
+    cfg2 = copy.deepcopy(cfg)
+    cfg2.score.scores_npz = npz
+    cfg2.train.checkpoint_dir = str(tmp_path / "ck2")
+    summary2 = run_datadiet(cfg2)
+    assert summary2["n_kept"] == summary1["n_kept"]
+    assert summary2["pretrain_wall_s"] == 0.0
+    d1 = np.load(npz)
+    d2 = np.load(f"{cfg2.train.checkpoint_dir}_scores.npz")
+    np.testing.assert_array_equal(np.sort(d1["kept"]), np.sort(d2["kept"]))
+
+    # Index join: a subsetted dataset picks its own rows out of the artifact.
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    train_ds, _ = load_dataset("synthetic", synthetic_size=256, seed=0)
+    sub = train_ds.subset(train_ds.indices[::2])
+    scores_sub = load_scores_npz(npz, sub)
+    np.testing.assert_array_equal(scores_sub, d1["scores"][::2])
+
+    # Missing examples refuse loudly.
+    from dataclasses import replace
+    alien = replace(sub, indices=sub.indices + 100_000)
+    with pytest.raises(KeyError):
+        load_scores_npz(npz, alien)
