@@ -16,10 +16,17 @@ The torch models here are written from the standard architecture definitions
 from the reference.
 """
 
-from .torch_models import (TorchBasicBlock, TorchResNet18, TorchTinyCNN,
+from .torch_models import (TORCH_MIRRORS, TorchBasicBlock,
+                           TorchBottleneckBlock, TorchResNet, TorchResNet18,
+                           TorchResNet34, TorchResNet50, TorchResNet101,
+                           TorchResNet152, TorchTinyCNN, TorchWideBlock,
+                           TorchWideResNet, TorchWideResNet28_10,
                            port_flax_to_torch, torch_el2n, torch_grand)
 from .train import train_torch_from_scratch
 
-__all__ = ["TorchTinyCNN", "TorchBasicBlock", "TorchResNet18",
+__all__ = ["TORCH_MIRRORS", "TorchTinyCNN", "TorchBasicBlock",
+           "TorchBottleneckBlock", "TorchResNet", "TorchResNet18",
+           "TorchResNet34", "TorchResNet50", "TorchResNet101", "TorchResNet152",
+           "TorchWideBlock", "TorchWideResNet", "TorchWideResNet28_10",
            "port_flax_to_torch", "torch_el2n", "torch_grand",
            "train_torch_from_scratch"]

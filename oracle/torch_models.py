@@ -36,6 +36,11 @@ class TorchTinyCNN(tnn.Module):
 
 
 class TorchBasicBlock(tnn.Module):
+    """Mirror of the Flax ``BasicBlock`` (``models/resnet.py:40-63``): two 3x3
+    convs, projection shortcut when shape changes. Expansion 1."""
+
+    expansion = 1
+
     def __init__(self, c_in, filters, stride):
         super().__init__()
         self.Conv_0 = tnn.Conv2d(c_in, filters, 3, stride=stride, padding=1,
@@ -55,29 +60,176 @@ class TorchBasicBlock(tnn.Module):
         return F.relu(r + y)
 
 
-class TorchResNet18(tnn.Module):
-    def __init__(self, num_classes=10, width=64):
+class TorchBottleneckBlock(tnn.Module):
+    """Mirror of the Flax ``BottleneckBlock`` (``models/resnet.py:66-91``):
+    1x1 -> 3x3(stride) -> 1x1(x4), like the reference's Bottleneck
+    (``/root/reference/models/resnet.py:35-63`` puts the stride on the 3x3 conv
+    too). Expansion 4."""
+
+    expansion = 4
+
+    def __init__(self, c_in, filters, stride):
         super().__init__()
-        self.stem_conv = tnn.Conv2d(3, width, 3, padding=1, bias=False)
+        out = filters * self.expansion
+        self.Conv_0 = tnn.Conv2d(c_in, filters, 1, bias=False)
+        self.BatchNorm_0 = tnn.BatchNorm2d(filters, eps=1e-5)
+        self.Conv_1 = tnn.Conv2d(filters, filters, 3, stride=stride, padding=1,
+                                 bias=False)
+        self.BatchNorm_1 = tnn.BatchNorm2d(filters, eps=1e-5)
+        self.Conv_2 = tnn.Conv2d(filters, out, 1, bias=False)
+        self.BatchNorm_2 = tnn.BatchNorm2d(out, eps=1e-5)
+        self.has_proj = stride != 1 or c_in != out
+        if self.has_proj:
+            self.proj_conv = tnn.Conv2d(c_in, out, 1, stride=stride, bias=False)
+            self.proj_norm = tnn.BatchNorm2d(out, eps=1e-5)
+
+    def forward(self, x):
+        y = F.relu(self.BatchNorm_0(self.Conv_0(x)))
+        y = F.relu(self.BatchNorm_1(self.Conv_1(y)))
+        y = self.BatchNorm_2(self.Conv_2(y))
+        r = self.proj_norm(self.proj_conv(x)) if self.has_proj else x
+        return F.relu(r + y)
+
+
+class TorchResNet(tnn.Module):
+    """Mirror of the Flax ``ResNet`` (``models/resnet.py:94-152``) for any stage
+    plan / block type / stem. Block modules are named ``{BlockClass}_{i}`` with
+    the Flax auto-naming (``Conv_0`` / ``BatchNorm_0`` / ...), so
+    ``port_flax_to_torch`` maps weights mechanically for every zoo member."""
+
+    def __init__(self, stage_sizes, block_cls, num_classes=10, width=64,
+                 stem="cifar"):
+        super().__init__()
+        self.stem = stem
+        if stem == "imagenet":
+            self.stem_conv = tnn.Conv2d(3, width, 7, stride=2, padding=3,
+                                        bias=False)
+        elif stem == "cifar":
+            self.stem_conv = tnn.Conv2d(3, width, 3, padding=1, bias=False)
+        else:
+            raise ValueError(f"unknown stem {stem!r} (cifar | imagenet)")
         self.stem_norm = tnn.BatchNorm2d(width, eps=1e-5)
-        c_in, i = width, 0
-        for stage, blocks in enumerate([2, 2, 2, 2]):
+        # Flax names blocks after the block class (models/resnet.py:141-143).
+        prefix = {TorchBasicBlock: "BasicBlock",
+                  TorchBottleneckBlock: "BottleneckBlock"}[block_cls]
+        self._block_names = []
+        c_in = width
+        for stage, blocks in enumerate(stage_sizes):
             filters = width * (2 ** stage)
             for b in range(blocks):
                 stride = 2 if stage > 0 and b == 0 else 1
-                self.add_module(f"BasicBlock_{i}",
-                                TorchBasicBlock(c_in, filters, stride))
-                c_in = filters
-                i += 1
-        self.n_blocks = i
+                name = f"{prefix}_{len(self._block_names)}"
+                self.add_module(name, block_cls(c_in, filters, stride))
+                self._block_names.append(name)
+                c_in = filters * block_cls.expansion
         self.classifier = tnn.Linear(c_in, num_classes)
 
     def forward(self, x):
         x = F.relu(self.stem_norm(self.stem_conv(x)))
-        for i in range(self.n_blocks):
-            x = getattr(self, f"BasicBlock_{i}")(x)
+        if self.stem == "imagenet":
+            x = F.max_pool2d(x, 3, stride=2, padding=1)
+        for name in self._block_names:
+            x = getattr(self, name)(x)
         x = x.mean(dim=(2, 3))
         return self.classifier(x)
+
+
+class TorchWideBlock(tnn.Module):
+    """Mirror of the Flax pre-activation ``WideBlock``
+    (``models/wideresnet.py:19-41``): BN-ReLU-Conv twice; the projection
+    branches off the pre-activation; no norm on the projection."""
+
+    def __init__(self, c_in, filters, stride):
+        super().__init__()
+        self.BatchNorm_0 = tnn.BatchNorm2d(c_in, eps=1e-5)
+        self.has_proj = c_in != filters or stride != 1
+        if self.has_proj:
+            self.proj_conv = tnn.Conv2d(c_in, filters, 1, stride=stride,
+                                        bias=False)
+        self.Conv_0 = tnn.Conv2d(c_in, filters, 3, stride=stride, padding=1,
+                                 bias=False)
+        self.BatchNorm_1 = tnn.BatchNorm2d(filters, eps=1e-5)
+        self.Conv_1 = tnn.Conv2d(filters, filters, 3, padding=1, bias=False)
+
+    def forward(self, x):
+        y = F.relu(self.BatchNorm_0(x))
+        r = self.proj_conv(y) if self.has_proj else x
+        y = self.Conv_0(y)
+        y = F.relu(self.BatchNorm_1(y))
+        y = self.Conv_1(y)
+        return r + y
+
+
+class TorchWideResNet(tnn.Module):
+    """Mirror of the Flax ``WideResNet`` (``models/wideresnet.py:44-82``):
+    bare conv stem, 3 stages of pre-activation wide blocks, final BN-ReLU."""
+
+    def __init__(self, depth=28, widen_factor=10, num_classes=10):
+        super().__init__()
+        if (depth - 4) % 6 != 0:
+            raise ValueError("WideResNet depth must be 6n+4")
+        n, k = (depth - 4) // 6, widen_factor
+        self.stem_conv = tnn.Conv2d(3, 16, 3, padding=1, bias=False)
+        self._block_names = []
+        c_in = 16
+        for stage, filters in enumerate((16 * k, 32 * k, 64 * k)):
+            for b in range(n):
+                stride = 2 if stage > 0 and b == 0 else 1
+                name = f"WideBlock_{len(self._block_names)}"
+                self.add_module(name, TorchWideBlock(c_in, filters, stride))
+                self._block_names.append(name)
+                c_in = filters
+        self.final_norm = tnn.BatchNorm2d(c_in, eps=1e-5)
+        self.classifier = tnn.Linear(c_in, num_classes)
+
+    def forward(self, x):
+        x = self.stem_conv(x)
+        for name in self._block_names:
+            x = getattr(self, name)(x)
+        x = F.relu(self.final_norm(x))
+        x = x.mean(dim=(2, 3))
+        return self.classifier(x)
+
+
+def TorchResNet18(num_classes=10, width=64, stem="cifar"):
+    return TorchResNet([2, 2, 2, 2], TorchBasicBlock, num_classes, width, stem)
+
+
+def TorchResNet34(num_classes=10, width=64, stem="cifar"):
+    return TorchResNet([3, 4, 6, 3], TorchBasicBlock, num_classes, width, stem)
+
+
+def TorchResNet50(num_classes=10, width=64, stem="cifar"):
+    return TorchResNet([3, 4, 6, 3], TorchBottleneckBlock, num_classes, width,
+                       stem)
+
+
+def TorchResNet101(num_classes=10, width=64, stem="cifar"):
+    return TorchResNet([3, 4, 23, 3], TorchBottleneckBlock, num_classes, width,
+                       stem)
+
+
+def TorchResNet152(num_classes=10, width=64, stem="cifar"):
+    return TorchResNet([3, 8, 36, 3], TorchBottleneckBlock, num_classes, width,
+                       stem)
+
+
+def TorchWideResNet28_10(num_classes=10):
+    return TorchWideResNet(depth=28, widen_factor=10, num_classes=num_classes)
+
+
+# One mirror per Flax registry arch (models/__init__.py:_REGISTRY). Factories
+# take ``num_classes`` (+ ``stem`` for the ResNets) so the export tool and the
+# parity tests can build the matching geometry for any checkpoint.
+TORCH_MIRRORS = {
+    "tiny_cnn": TorchTinyCNN,
+    "resnet18": TorchResNet18,
+    "resnet34": TorchResNet34,
+    "resnet50": TorchResNet50,
+    "resnet101": TorchResNet101,
+    "resnet152": TorchResNet152,
+    "wideresnet28_10": TorchWideResNet28_10,
+}
 
 
 def port_flax_to_torch(variables, torch_model):

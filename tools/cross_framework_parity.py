@@ -68,10 +68,10 @@ def jax_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
 def torch_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
     import torch
 
-    from oracle import (TorchResNet18, TorchTinyCNN, torch_el2n, torch_grand,
+    from oracle import (TORCH_MIRRORS, torch_el2n, torch_grand,
                         train_torch_from_scratch)
 
-    mirror = {"tiny_cnn": TorchTinyCNN, "resnet18": TorchResNet18}[args.arch]
+    mirror = TORCH_MIRRORS[args.arch]
     x = np.asarray(train_ds.images, np.float32)
     y = np.asarray(train_ds.labels, np.int64)
     x_nchw = torch.tensor(np.ascontiguousarray(x.transpose(0, 3, 1, 2)))
@@ -108,7 +108,8 @@ def main() -> None:
     parser.add_argument("--batch", type=int, default=128)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--arch", default="tiny_cnn",
-                        choices=["tiny_cnn", "resnet18"])
+                        choices=["tiny_cnn", "resnet18", "resnet34", "resnet50",
+                                 "resnet101", "resnet152", "wideresnet28_10"])
     parser.add_argument("--seeds", type=int, nargs="+", default=[0, 1, 2])
     parser.add_argument("--methods", nargs="+", default=["el2n", "grand"])
     parser.add_argument("--out", default="artifacts/cross_framework_parity.npz")

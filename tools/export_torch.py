@@ -13,9 +13,9 @@ Run (CPU recipe is fine — checkpoints are backend-agnostic):
       --checkpoint-dir ./checkpoints --arch resnet18 --num-classes 10 \
       --out model_torch.pt [--step N]
 
-Writes ``{"state_dict", "arch", "num_classes", "step"}`` via ``torch.save``;
-load with ``TorchResNet18(...).load_state_dict(payload["state_dict"])`` (the
-mirror classes ship in ``oracle/``).
+Writes ``{"state_dict", "arch", "num_classes", "stem", "step"}`` via
+``torch.save``; load with the matching mirror from ``oracle.TORCH_MIRRORS``,
+e.g. ``TORCH_MIRRORS["resnet50"](num_classes=...).load_state_dict(...)``.
 """
 
 from __future__ import annotations
@@ -29,7 +29,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-MIRRORS = {"tiny_cnn": "TorchTinyCNN", "resnet18": "TorchResNet18"}
+# Keep in sync with oracle.TORCH_MIRRORS (asserted at runtime) — static here so
+# --help works without importing torch.
+ARCHS = ["tiny_cnn", "resnet18", "resnet34", "resnet50", "resnet101",
+         "resnet152", "wideresnet28_10"]
+# Archs whose mirror has the cifar/imagenet stem switch (the ResNet zoo).
+STEM_ARCHS = {"resnet18", "resnet34", "resnet50", "resnet101", "resnet152"}
 
 
 def main() -> None:
@@ -37,16 +42,18 @@ def main() -> None:
     parser.add_argument("--checkpoint-dir", required=True)
     parser.add_argument("--step", type=int, default=None,
                         help="checkpoint step (default: latest)")
-    parser.add_argument("--arch", default="resnet18", choices=sorted(MIRRORS))
+    parser.add_argument("--arch", default="resnet18", choices=ARCHS)
     parser.add_argument("--num-classes", type=int, default=10)
-    # The torch mirrors are cifar-geometry; an imagenet-stem checkpoint has no
-    # mirror to port into, and a stem mismatch would otherwise surface as an
-    # opaque Orbax tree/shape error at restore — refuse up front instead.
-    parser.add_argument("--stem", default="cifar", choices=["cifar"],
-                        help="checkpoint stem geometry (only cifar-stem "
-                             "checkpoints have torch mirrors)")
+    # A stem mismatch would otherwise surface as an opaque Orbax tree/shape
+    # error at restore — refuse up front instead.
+    parser.add_argument("--stem", default="cifar", choices=["cifar", "imagenet"],
+                        help="checkpoint stem geometry (imagenet is a ResNet "
+                             "variant; tiny_cnn/wideresnet are cifar-only)")
     parser.add_argument("--out", required=True)
     args = parser.parse_args()
+    if args.stem != "cifar" and args.arch not in STEM_ARCHS:
+        parser.error(f"--stem {args.stem} is only available for "
+                     f"{sorted(STEM_ARCHS)}")
 
     import jax
     import torch
@@ -72,11 +79,19 @@ def main() -> None:
             f"{exc}") from exc
     mngr.close()
 
-    mirror = getattr(oracle, MIRRORS[args.arch])(num_classes=args.num_classes)
+    assert set(oracle.TORCH_MIRRORS) == set(ARCHS), "ARCHS out of sync"
+    import inspect
+    derived_stem = {a for a, f in oracle.TORCH_MIRRORS.items()
+                    if "stem" in inspect.signature(f).parameters}
+    assert derived_stem == STEM_ARCHS, "STEM_ARCHS out of sync"
+    mirror_kw = {"stem": args.stem} if args.arch in STEM_ARCHS else {}
+    mirror = oracle.TORCH_MIRRORS[args.arch](num_classes=args.num_classes,
+                                             **mirror_kw)
     oracle.port_flax_to_torch(jax.device_get(variables), mirror)
 
     payload = {"state_dict": mirror.state_dict(), "arch": args.arch,
-               "num_classes": args.num_classes, "step": int(step)}
+               "num_classes": args.num_classes, "stem": args.stem,
+               "step": int(step)}
     torch.save(payload, args.out)
     n_params = int(sum(np.prod(v.shape) for v in payload["state_dict"].values()))
     print(json.dumps({"out": args.out, "arch": args.arch, "step": int(step),
