@@ -1,0 +1,208 @@
+"""ImageNet-scale ingestion soak (VERDICT r4 next #8): run BASELINE config 5's
+geometry — ResNet-50, 96x96 images, 100 classes, >=200k rows — through the
+memory-mapped ``.npy`` pipeline beyond the multichip dryrun, and measure what
+the round-4 work only pinned structurally:
+
+* **ingestion throughput**: a full epoch of production batch assembly
+  (C++ gather + lazy uint8 normalization + device upload) over the mmap;
+* **scoring rate**: EL2N (and optionally GraNd) through ``score_dataset`` on a
+  bounded row count (full-set on TPU; a subset keeps the CPU recipe bounded);
+* **host-RSS bound**: peak ANONYMOUS memory (``/proc/self/status`` RssAnon)
+  during the epoch — the number that must stay O(batch), not O(dataset).
+  File-backed mmap pages are reclaimable page cache and excluded by design
+  (same accounting as ``tests/test_data.py``'s RLIMIT_DATA harness).
+
+The dataset is synthetic-imagenet (class templates + noise, the same structure
+as ``data/datasets._synthetic``) quantized to uint8 and written CHUNKED straight
+into the ``{split}_images.npy`` layout with ``stats.npz`` — a 5.3 GB train
+split never exists as float32 in RAM. Reference analogue: torchvision folder
+ingestion at ImageNet scale (``/root/reference/data/loader.py:27-43`` only ever
+loads CIFAR; this framework's claim to that scale is what this soak checks).
+
+CPU recipe:
+  env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/imagenet_soak.py --rows 200000 --score-rows 2048
+TPU: python tools/imagenet_soak.py --rows 200000 --score-rows 0   # 0 = all
+
+Prints one JSON line; numbers are recorded in SCALING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_anon_mb() -> float:
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("RssAnon:"):
+                return int(line.split()[1]) / 1024.0
+    return float("nan")
+
+
+def generate(data_dir: str, rows: int, image_size: int, classes: int,
+             seed: int, chunk: int = 8192) -> float:
+    """Write {train,test}_images.npy (uint8) + labels + stats.npz, chunked."""
+    os.makedirs(data_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    template_rng = np.random.default_rng(np.random.SeedSequence([seed, 0xD1E7]))
+    templates = template_rng.normal(
+        0.0, 0.5, size=(classes, image_size, image_size, 3)).astype(np.float32)
+    channel_sig = template_rng.normal(
+        0.0, 1.0, size=(classes, 1, 1, 3)).astype(np.float32)
+
+    s = np.zeros(3, np.float64)
+    s2 = np.zeros(3, np.float64)
+    npix = 0
+    for split, n in (("train", rows), ("test", max(rows // 20, classes))):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([seed, 1 if split == "train" else 2]))
+        labels = rng.integers(0, classes, size=n).astype(np.int32)
+        out = np.lib.format.open_memmap(
+            os.path.join(data_dir, f"{split}_images.npy"), mode="w+",
+            dtype=np.uint8, shape=(n, image_size, image_size, 3))
+        for i in range(0, n, chunk):
+            lab = labels[i:i + chunk]
+            x = (templates[lab] + channel_sig[lab]
+                 + rng.normal(0.0, 0.4, size=(len(lab), image_size, image_size,
+                                              3)).astype(np.float32))
+            # Quantize the ~N(0, 0.8) float field into uint8 with headroom.
+            u8 = np.clip(np.rint(x * 48.0 + 128.0), 0, 255).astype(np.uint8)
+            out[i:i + chunk] = u8
+            if split == "train":
+                c = u8.astype(np.float64) / 255.0
+                s += c.sum(axis=(0, 1, 2))
+                s2 += np.square(c).sum(axis=(0, 1, 2))
+                npix += c.shape[0] * c.shape[1] * c.shape[2]
+        out.flush()
+        del out
+        np.save(os.path.join(data_dir, f"{split}_labels.npy"), labels)
+    mean = s / npix
+    std = np.sqrt(np.maximum(s2 / npix - mean**2, 0.0)) + 1e-8
+    np.savez(os.path.join(data_dir, "stats.npz"),
+             mean=mean.astype(np.float32), std=std.astype(np.float32))
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", default="/tmp/imagenet_soak_data")
+    parser.add_argument("--rows", type=int, default=200_000)
+    parser.add_argument("--image-size", type=int, default=96)
+    parser.add_argument("--classes", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--arch", default="resnet50")
+    parser.add_argument("--score-rows", type=int, default=2048,
+                        help="rows for the scoring-rate measurement "
+                             "(0 = the whole train split)")
+    parser.add_argument("--score-method", default="el2n")
+    parser.add_argument("--half-precision", action="store_true")
+    args = parser.parse_args()
+
+    have = all(os.path.exists(os.path.join(args.data_dir, f))
+               for f in ("train_images.npy", "train_labels.npy",
+                         "test_images.npy", "test_labels.npy", "stats.npz"))
+    if have:
+        # A stale dir with different geometry would silently measure the wrong
+        # dataset (and out-of-range labels would silently zero in one_hot).
+        imgs = np.load(os.path.join(args.data_dir, "train_images.npy"),
+                       mmap_mode="r")
+        labs = np.load(os.path.join(args.data_dir, "train_labels.npy"))
+        want = (args.rows, args.image_size, args.image_size, 3)
+        if imgs.shape != want or int(labs.max()) >= args.classes:
+            raise SystemExit(
+                f"{args.data_dir} holds images {imgs.shape} / labels up to "
+                f"{int(labs.max())}, but this run asked for {want} / "
+                f"{args.classes} classes — delete the dir or pass a fresh "
+                "--data-dir")
+        del imgs, labs
+    gen_s = None
+    if not have:
+        gen_s = generate(args.data_dir, args.rows, args.image_size,
+                         args.classes, args.seed)
+
+    import jax
+    import jax.numpy as jnp
+
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder, device_stream
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.ops.scoring import score_dataset
+    from data_diet_distributed_tpu.parallel.mesh import make_mesh
+
+    train_ds, _ = load_dataset("npz", args.data_dir)
+    assert isinstance(train_ds.images, np.memmap), "expected mmap ingestion"
+    n = len(train_ds)
+    bytes_per_row = int(np.prod(train_ds.images.shape[1:]))  # uint8
+    mesh = make_mesh(None)
+    sharder = BatchSharder.flat(mesh)
+    batch = sharder.global_batch_size_for(args.batch)
+
+    # --- Ingestion: one full production epoch of assembly + upload. ---
+    rss0 = rss_anon_mb()
+    peak = rss0
+    t0 = time.perf_counter()
+    rows = 0
+    for _, db in device_stream(train_ds, batch, sharder, shuffle=True,
+                               seed=args.seed, epoch=0):
+        rows += int(db["mask"].sum())
+        if rows % (batch * 64) < batch:
+            peak = max(peak, rss_anon_mb())
+    jax.block_until_ready(db["image"])
+    ingest_s = time.perf_counter() - t0
+    peak = max(peak, rss_anon_mb())
+
+    # --- Scoring rate: ResNet-50, imagenet stem, through score_dataset. ---
+    score_ds = (train_ds if args.score_rows in (0, None) or args.score_rows >= n
+                else train_ds.subset(np.arange(args.score_rows, dtype=np.int64)))
+    dtype = jnp.bfloat16 if args.half_precision else jnp.float32
+    model = create_model(args.arch, args.classes,
+                         half_precision=args.half_precision, stem="imagenet")
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, args.image_size, args.image_size, 3),
+                                     dtype))
+    # One shared compiled step: a warmup pass over one batch eats the compile,
+    # so the measured rate is the steady-state scoring throughput.
+    from data_diet_distributed_tpu.ops.scores import make_score_step
+    score_step = make_score_step(model, args.score_method, mesh)
+    warm = score_ds.subset(np.arange(min(batch, len(score_ds)), dtype=np.int64))
+    score_dataset(model, [variables], warm, method=args.score_method,
+                  batch_size=args.batch, sharder=sharder,
+                  device_resident=False, score_step=score_step)
+    t0 = time.perf_counter()
+    scores = score_dataset(model, [variables], score_ds,
+                           method=args.score_method, batch_size=args.batch,
+                           sharder=sharder, device_resident=False,
+                           score_step=score_step)
+    score_s = time.perf_counter() - t0
+    peak = max(peak, rss_anon_mb())
+
+    print(json.dumps({
+        "rows": n, "image_size": args.image_size,
+        "dataset_gb": round(n * bytes_per_row / 1e9, 2),
+        "generate_s": None if gen_s is None else round(gen_s, 1),
+        "ingest_examples_per_s": round(rows / ingest_s, 1),
+        "ingest_gb_per_s": round(rows * bytes_per_row / ingest_s / 1e9, 3),
+        "score_arch": args.arch, "score_method": args.score_method,
+        "score_rows": len(score_ds),
+        "score_examples_per_s": round(len(score_ds) / score_s, 1),
+        "rss_anon_start_mb": round(rss0, 1),
+        "rss_anon_peak_mb": round(peak, 1),
+        "n_devices": mesh.size,
+        "platform": jax.devices()[0].platform,
+        "scores_mean": float(np.mean(scores)),
+    }))
+
+
+if __name__ == "__main__":
+    main()
