@@ -85,7 +85,11 @@ GROUP_CONV = _toggle("DDT_GRAND_GROUP_CONV", False)
 GROUP_BN = _toggle("DDT_GRAND_GROUP_BN", False)
 USE_BN_KERNEL = _toggle("DDT_GRAND_BN_KERNEL", False)
 USE_CATDOT = _toggle("DDT_GRAND_CATDOT", False)
-STEM_XLA = _toggle("DDT_GRAND_STEM_XLA", False)  # tiny-F convs via XLA patches
+# Tiny-F convs (the 3-channel stem) via XLA's fused patch einsum instead of
+# the Pallas path. Default ON: the round-5 on-chip bisection measured it the
+# only winning toggle — 12,475-12,542 ex/s/chip vs 11,929-12,218 baseline
+# (+4%, consistent across 3 runs; every other combo lost, bisect_results_r5*.json).
+STEM_XLA = _toggle("DDT_GRAND_STEM_XLA", True)
 
 
 def _canon_tuple(v, n: int) -> tuple:

@@ -219,7 +219,7 @@ def test_grouped_dispatch_matches_ungrouped(monkeypatch):
                 variables, batch))
 
     base = run(GROUP_CONV=False, GROUP_BN=False, USE_BN_KERNEL=False,
-               USE_CATDOT=False)
+               USE_CATDOT=False, STEM_XLA=False)
     for flags in (dict(GROUP_CONV=True),
                   dict(GROUP_BN=True, USE_BN_KERNEL=True),
                   dict(STEM_XLA=True),

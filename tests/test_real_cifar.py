@@ -50,6 +50,13 @@ def detect_cifar_layout(data_dir: str) -> str | None:
     if (os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))
             or os.path.exists(os.path.join(data_dir, "cifar-10-python.tar.gz"))):
         return "pickle"
+    # Converted mmap splits ({split}_images.npy / {split}_labels.npy, e.g.
+    # from tools/npz_to_npy.py) are checked BEFORE whole-file npz because
+    # load_dataset("npz", ...) itself prefers them when both are present
+    # (datasets.has_npy_splits branch) — the gate must mirror the loader.
+    from data_diet_distributed_tpu.data.datasets import has_npy_splits
+    if has_npy_splits(data_dir):
+        return "npy"
     if (os.path.exists(os.path.join(data_dir, "train.npz"))
             and os.path.exists(os.path.join(data_dir, "test.npz"))):
         return "npz"
@@ -71,9 +78,18 @@ def test_layout_detection(tmp_path):
     """The gate itself, exercised WITHOUT real data so it cannot rot while the
     dataset stays unavailable: both layouts are detected, empty dirs are not."""
     assert detect_cifar_layout(str(tmp_path)) is None
+    (tmp_path / "train_images.npy").touch()
+    (tmp_path / "train_labels.npy").touch()
+    assert detect_cifar_layout(str(tmp_path)) is None   # npy needs all four
+    (tmp_path / "test_images.npy").touch()
+    (tmp_path / "test_labels.npy").touch()
+    assert detect_cifar_layout(str(tmp_path)) == "npy"
     (tmp_path / "train.npz").touch()
-    assert detect_cifar_layout(str(tmp_path)) is None   # npz needs both splits
     (tmp_path / "test.npz").touch()
+    assert detect_cifar_layout(str(tmp_path)) == "npy"  # loader prefers npy
+    for p in ("train_images.npy", "train_labels.npy",
+              "test_images.npy", "test_labels.npy"):
+        (tmp_path / p).unlink()
     assert detect_cifar_layout(str(tmp_path)) == "npz"
     (tmp_path / "cifar-10-batches-py").mkdir()
     assert detect_cifar_layout(str(tmp_path)) == "pickle"   # pickle wins

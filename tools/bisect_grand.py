@@ -24,19 +24,27 @@ import os
 import subprocess
 import sys
 
+# Every toggle is pinned in every combo (off unless the combo names it):
+# module-level defaults may change as bisections promote winners (STEM_XLA
+# did in round 5), and an unpinned combo would silently inherit them —
+# "baseline" must always measure the all-off program.
+_ALL_OFF = {f"DDT_GRAND_{k}": "0" for k in
+            ("GROUP_CONV", "GROUP_BN", "BN_KERNEL", "CATDOT", "STEM_XLA")}
+
+
+def _combo(*on: str) -> dict:
+    return {**_ALL_OFF, **{f"DDT_GRAND_{k}": "1" for k in on}}
+
+
 COMBOS = [
-    ("baseline", {}),
-    ("catdot", {"DDT_GRAND_CATDOT": "1"}),
-    ("bn_kernel", {"DDT_GRAND_BN_KERNEL": "1"}),
-    ("bn_kernel+catdot", {"DDT_GRAND_BN_KERNEL": "1",
-                          "DDT_GRAND_CATDOT": "1"}),
-    ("bn_kernel+group_bn", {"DDT_GRAND_BN_KERNEL": "1",
-                            "DDT_GRAND_GROUP_BN": "1"}),
-    ("group_conv", {"DDT_GRAND_GROUP_CONV": "1"}),
-    ("stem_xla", {"DDT_GRAND_STEM_XLA": "1"}),
-    ("bn_kernel+catdot+stem_xla", {"DDT_GRAND_BN_KERNEL": "1",
-                                   "DDT_GRAND_CATDOT": "1",
-                                   "DDT_GRAND_STEM_XLA": "1"}),
+    ("baseline", _combo()),
+    ("catdot", _combo("CATDOT")),
+    ("bn_kernel", _combo("BN_KERNEL")),
+    ("bn_kernel+catdot", _combo("BN_KERNEL", "CATDOT")),
+    ("bn_kernel+group_bn", _combo("BN_KERNEL", "GROUP_BN")),
+    ("group_conv", _combo("GROUP_CONV")),
+    ("stem_xla", _combo("STEM_XLA")),
+    ("bn_kernel+catdot+stem_xla", _combo("BN_KERNEL", "CATDOT", "STEM_XLA")),
 ]
 
 FAST = ("baseline", "bn_kernel", "catdot", "bn_kernel+catdot")

@@ -99,6 +99,25 @@ def main() -> None:
     env = cli_env(args.platform)
     wd = os.path.abspath(args.workdir)
     os.makedirs(wd, exist_ok=True)
+    # MetricsLogger appends and read_records collects every matching row, and
+    # a stale checkpoint dir would resume mid-recipe — a rerun in the same
+    # workdir must start from a clean slate.
+    import glob
+    import shutil
+    for sub in ("dense", "hard", "rand"):
+        # Sweep outputs are SIBLINGS of the checkpoint dir ({dir}_s0p5/,
+        # {dir}_s0p5_scores.npz — train.loop.sweep_level_dir/scores_npz_path),
+        # so the clean slate must cover {sub}_* as well as {sub}/.
+        shutil.rmtree(os.path.join(wd, sub), ignore_errors=True)
+        for stale in glob.glob(os.path.join(wd, f"{sub}_*")):
+            if os.path.isdir(stale):
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                os.unlink(stale)
+    for m in ("metrics_dense.jsonl", "metrics_hard.jsonl",
+              "metrics_rand.jsonl"):
+        with open(os.path.join(wd, m), "w"):
+            pass
     out_dir = os.path.abspath(args.out)
     os.makedirs(out_dir, exist_ok=True)
 

@@ -12,12 +12,16 @@ Run: python tools/profile_grand.py [--batch 1024] [--arch resnet18]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from data_diet_distributed_tpu.models import create_model
 from data_diet_distributed_tpu.ops import grand_batched as gb
