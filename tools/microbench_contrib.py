@@ -1,0 +1,92 @@
+"""Per-geometry A/B of the conv grad-norm contraction routes, on-device.
+
+For each hot ResNet-18 layer geometry (round-5 profile: stage-1 is 43% of
+contraction time at 21.6 TF/s), times the production Pallas route against the
+XLA patches-einsum fallback using the same carry-dependent fori_loop
+methodology as tools/profile_grand.py (cancels dispatch overhead).
+
+Run: python tools/microbench_contrib.py [--batch 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from data_diet_distributed_tpu.ops import grand_batched as gb
+
+N_LONG, N_SHORT = 9, 1
+
+# (name, x_hw, x_c, g_hw, g_c, k, stride) — the profile's top rows.
+GEOMS = [
+    ("stage1 (x4, 43%)", 32, 64, 32, 64, 3, 1),
+    ("stage2_down", 32, 64, 16, 128, 3, 2),
+    ("stage2 (x3)", 16, 128, 16, 128, 3, 1),
+    ("stage3_down", 16, 128, 8, 256, 3, 2),
+    ("stage3 (x3)", 8, 256, 8, 256, 3, 1),
+    ("stage4_down", 8, 256, 4, 512, 3, 2),
+    ("stage4 (x3)", 4, 512, 4, 512, 3, 1),
+    ("proj2", 32, 64, 16, 128, 1, 2),
+    ("proj3", 16, 128, 8, 256, 1, 2),
+    ("proj4", 8, 256, 4, 512, 1, 2),
+]
+
+
+def per_iter_seconds(fn, *args):
+    fn(N_SHORT, *args).block_until_ready()
+    float(fn(N_SHORT, *args))
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(fn(n, *args))
+        return time.perf_counter() - t0
+    t_s, t_l = run(N_SHORT), run(N_LONG)
+    t_s, t_l = min(t_s, run(N_SHORT)), min(t_l, run(N_LONG))
+    return (t_l - t_s) / (N_LONG - N_SHORT)
+
+
+def repeated(payload):
+    @jax.jit
+    def fn(n, *args):
+        def body(_, acc):
+            eps = acc * jnp.float32(1e-30)
+            out = payload(*[a + eps.astype(a.dtype) for a in args])
+            return acc + jnp.sum(out.astype(jnp.float32))
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+    return fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    b = args.batch
+    dt = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    for name, xh, xc, gh, gc, k, s in GEOMS:
+        x = jnp.asarray(rng.standard_normal((b, xh, xh, xc)), dt)
+        g = jnp.asarray(rng.standard_normal((b, gh, gh, gc)), dt)
+        rec = {"kind": "conv", "path": ("m",), "kernel_size": (k, k),
+               "strides": (s, s), "padding": "SAME", "use_bias": False}
+        flops = 2 * b * gh * gh * (k * k * xc) * gc
+        row = [f"{name:18s}"]
+        for label, use_pallas in (("pallas", True), ("xla", False)):
+            t = per_iter_seconds(
+                repeated(partial(gb._conv_contrib, rec,
+                                 use_pallas=use_pallas)), x, g)
+            row.append(f"{label} {t*1e3:7.2f} ms {flops/t/1e12:6.1f} TF/s")
+        print("  |  ".join(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
