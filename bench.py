@@ -136,6 +136,9 @@ def main() -> None:
     parser.add_argument("--probe-timeout", type=float, default=150.0)
     parser.add_argument("--no-probe", action="store_true",
                         help="skip the subprocess backend probe (CI/CPU runs)")
+    parser.add_argument("--no-pallas", action="store_true",
+                        help="XLA-only contractions (isolates Mosaic kernel "
+                             "compile failures; the PERFORMANCE.md XLA row)")
     parser.add_argument("--num-processes", type=int, default=1,
                         help="multi-process run: launch one bench.py per "
                              "process with matching --process-id; see "
@@ -213,7 +216,8 @@ def bench_score(args, metric: str) -> None:
         np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
     variables = replicate(variables, mesh)
 
-    step = make_score_step(model, args.method, mesh, chunk=args.chunk)
+    step = make_score_step(model, args.method, mesh, chunk=args.chunk,
+                           use_pallas=False if args.no_pallas else None)
     device_batches = [sharder(b) for b in
                       iterate_batches(train_ds, batch_size, shuffle=False)]
 
@@ -288,7 +292,8 @@ def bench_northstar(args, metric: str) -> None:
                   for s in range(args.seeds)]
 
     kw = dict(method="grand", batch_size=batch_size, sharder=sharder,
-              chunk=args.chunk)
+              chunk=args.chunk,
+              use_pallas=False if args.no_pallas else None)
     # Warm compile + upload path on one batch-shaped slice, single seed.
     score_dataset(model, seeds_vars[:1],
                   train_ds.subset(train_ds.indices[:batch_size]), **kw)

@@ -1,0 +1,74 @@
+"""Find which conv geometry's Pallas contraction kernel fails to compile.
+
+Round-5 discovery: WRN-28-10 batched GraNd with the default (Pallas) route
+dies in the relay's remote-compile helper (HTTP 500, subprocess exit 1) at
+every batch size, while ``--no-pallas`` compiles and runs — some Mosaic
+kernel at a WRN geometry is the culprit. This probes each WRN conv geometry
+in a bounded SUBPROCESS (a compile crash kills only that probe) and prints
+one OK/FAIL line per geometry.
+
+Run: python tools/probe_wrn_compile.py [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (name, x_hw, x_c, g_hw, g_c, k, stride) — every WRN-28-10 conv geometry.
+GEOMS = [
+    ("widen_in", 32, 16, 32, 160, 3, 1),
+    ("group1", 32, 160, 32, 160, 3, 1),
+    ("down2", 32, 160, 16, 320, 3, 2),
+    ("group2", 16, 320, 16, 320, 3, 1),
+    ("down3", 16, 320, 8, 640, 3, 2),
+    ("group3", 8, 640, 8, 640, 3, 1),
+    ("proj1", 32, 16, 32, 160, 1, 1),
+    ("proj2", 32, 160, 16, 320, 1, 2),
+    ("proj3", 16, 320, 8, 640, 1, 2),
+]
+
+_CHILD = r"""
+import sys
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, {repo!r})
+from data_diet_distributed_tpu.ops import grand_batched as gb
+b, xh, xc, gh, gc, k, s = {geom}
+rec = {{"kind": "conv", "path": ("m",), "kernel_size": (k, k),
+       "strides": (s, s), "padding": "SAME", "use_bias": False}}
+x = jnp.zeros((b, xh, xh, xc), jnp.bfloat16)
+g = jnp.zeros((b, gh, gh, gc), jnp.bfloat16)
+fn = jax.jit(lambda x, g: gb._conv_contrib(rec, x, g, use_pallas=True))
+fn.lower(x, g).compile()
+print("COMPILED")
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--timeout", type=int, default=420)
+    args = ap.parse_args()
+    for name, *geom in GEOMS:
+        code = _CHILD.format(repo=REPO, geom=tuple([args.batch] + geom))
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            print(f"{name:10s}: TIMEOUT", flush=True)
+            continue
+        if proc.returncode == 0 and "COMPILED" in proc.stdout:
+            print(f"{name:10s}: ok", flush=True)
+        else:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            print(f"{name:10s}: FAIL rc={proc.returncode} | "
+                  + " | ".join(tail[-3:]), flush=True)
+
+
+if __name__ == "__main__":
+    main()
