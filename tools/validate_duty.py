@@ -42,14 +42,21 @@ def main() -> None:
     t_start = time.time()
     with ResourceMonitor(path, interval_s=0.5):
         time.sleep(args.idle_s)
-        t_busy0 = time.time()
         x = jnp.ones((args.dim, args.dim), jnp.bfloat16)
-        f = jax.jit(lambda x: x @ x * 0.5 + 1.0)
+        # One dispatch = ~100 chained matmuls of device work: per-op dispatch
+        # from a 1-core host through the relay cannot outrun the device (the
+        # round-5 first attempt measured duty 0.2-0.75 because the "load" was
+        # genuinely dispatch-bound), and a tight dispatch loop starves the
+        # monitor thread of the GIL. A fori_loop payload keeps the queue
+        # holding seconds of work from a handful of cheap dispatches.
+        f = jax.jit(lambda x: jax.lax.fori_loop(
+            0, 500, lambda i, v: v @ v * 0.5 + 1.0, x))
         x = f(x)                     # compile outside the timed window
         float(jnp.sum(x.astype(jnp.float32)))
         t_busy0 = time.time()
         while time.time() - t_busy0 < args.busy_s:
             x = f(x)
+            time.sleep(0.25)         # GIL for the monitor; queue stays deep
         # Fetch-sync: the queue drains here, inside the busy window's tail.
         float(jnp.sum(x.astype(jnp.float32)))
         t_busy1 = time.time()
