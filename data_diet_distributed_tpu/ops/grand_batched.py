@@ -90,6 +90,13 @@ USE_CATDOT = _toggle("DDT_GRAND_CATDOT", False)
 # only winning toggle — 12,475-12,542 ex/s/chip vs 11,929-12,218 baseline
 # (+4%, consistent across 3 runs; every other combo lost, bisect_results_r5*.json).
 STEM_XLA = _toggle("DDT_GRAND_STEM_XLA", True)
+# Contract each layer's cotangent INSIDE the backward pass (custom_vjp taps)
+# instead of returning all cotangents from jax.grad and contracting afterwards
+# (``batched_grand_scores_fused``). Attacks the ~26 ms/batch-1024 composition
+# overhead the round-5 profile measured between the bwd and the contraction
+# phase: cotangents are consumed where they are produced and never become
+# grad *outputs*, so the all-layer cotangent pytree is no longer live at once.
+FUSED_BWD = _toggle("DDT_GRAND_FUSED", False)
 
 
 def _canon_tuple(v, n: int) -> tuple:
@@ -390,6 +397,117 @@ def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array
     return contrib
 
 
+def _check_covered(records: list[dict], variables) -> None:
+    """Every parameter must belong to an intercepted layer — otherwise its
+    gradient would be silently missing from the norm (unlike the loud
+    NotImplementedErrors for grouped/dilated convs). Conservative by design: a
+    parameterized-but-unused module also trips this (its true contribution is
+    zero, but we cannot tell "unused" from "missed" here)."""
+    covered = {rec["path"] for rec in records}
+    for path, _ in jax.tree_util.tree_flatten_with_path(
+            variables.get("params", {}))[0]:
+        mod_path = tuple(p.key for p in path[:-1])
+        if mod_path not in covered:
+            raise NotImplementedError(
+                f"batched GraNd: parameters at {'/'.join(mod_path)} belong to a "
+                "module type the interceptor does not cover (only Conv/Dense/"
+                "BatchNorm are); use the grand_vmap score method")
+
+
+def batched_grand_scores_fused(model, variables, image, label, mask,
+                               use_pallas: bool = False) -> jax.Array:
+    """Exact per-example GraNd with per-layer contractions fused INTO the
+    backward pass. Same math as ``batched_grand_scores`` (verified to the same
+    ``vmap(grad)`` tolerance) but instead of differentiating w.r.t. zero output
+    perturbations and contracting the returned cotangent pytree afterwards,
+    every Conv/Dense/BatchNorm output is wrapped in a ``custom_vjp`` tap whose
+    backward (a) passes the cotangent ``g`` through unchanged and (b) emits the
+    layer's closed-form grad-norm² contribution as the gradient of a dummy [B]
+    accumulator input. ``jax.grad`` w.r.t. the accumulators then yields every
+    per-layer contribution from ONE backward in which each contraction sits
+    immediately after the op that produced its ``g`` — no second phase, no
+    all-layer cotangent tree materialized as grad outputs."""
+    from .scores import cross_entropy  # local import: scores.py imports this module
+
+    # The fused path contracts strictly per layer — the grouping/stacked-BN
+    # machinery lives only in the two-phase path. Refuse the combination
+    # loudly so a bisect combo can never measure a silently mislabeled
+    # program (same policy as _toggle's typo rejection).
+    if GROUP_CONV or GROUP_BN or USE_BN_KERNEL:
+        raise ValueError(
+            "DDT_GRAND_FUSED=1 is incompatible with DDT_GRAND_GROUP_CONV/"
+            "GROUP_BN/BN_KERNEL (the fused backward contracts per layer; "
+            "grouping exists only in the two-phase path)")
+
+    records: list[dict] = []
+    cap_int = _make_interceptor(records)
+
+    def init_shapes(img):
+        with nn.intercept_methods(cap_int):
+            model.apply(variables, img, train=False,
+                        mutable=["ddt_pert", "ddt_in"])
+        return 0
+    jax.eval_shape(init_shapes, image)  # abstract: records metadata, no FLOPs
+    _check_covered(records, variables)
+
+    batch_stats = variables.get("batch_stats", {})
+    rec_by_path = {rec["path"]: rec for rec in records}
+    batch = image.shape[0]
+
+    def _contrib(rec: dict, x: jax.Array, g: jax.Array) -> jax.Array:
+        if rec["kind"] == "conv":
+            return _conv_contrib(rec, x, g, use_pallas)
+        if rec["kind"] == "dense":
+            return _dense_contrib(rec, x, g)
+        return _bn_contrib(rec, x, g, batch_stats)
+
+    def _make_tap(rec: dict):
+        @jax.custom_vjp
+        def tap(y, x, acc):
+            return y
+
+        def fwd(y, x, acc):
+            return y, x
+
+        def bwd(x, g):
+            # g flows through to the layer output untouched; x's true cotangent
+            # arrives via the layer's own backward (the zeros here are
+            # algebraically simplified away by XLA).
+            return g, jnp.zeros_like(x), _contrib(rec, x, g)
+
+        tap.defvjp(fwd, bwd)
+        return tap
+
+    taps = {path: _make_tap(rec) for path, rec in rec_by_path.items()}
+    # The interceptor runs inside model.apply, so the traced accumulators reach
+    # it through this cell (rebound per loss_fn call).
+    acc_cell: dict = {}
+
+    def fused_interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if (context.method_name != "__call__"
+                or not isinstance(mod, (nn.Conv, nn.Dense, nn.BatchNorm))
+                or mod.scope is None):
+            return next_fun(*args, **kwargs)
+        path = tuple(mod.path)
+        y = next_fun(*args, **kwargs)
+        return taps[path](y, args[0], acc_cell[path])
+
+    def loss_fn(accs):
+        acc_cell.clear()
+        acc_cell.update(accs)
+        with nn.intercept_methods(fused_interceptor):
+            logits = model.apply(variables, image, train=False)
+        return jnp.sum(cross_entropy(logits, label) * mask)
+
+    acc0 = {path: jnp.zeros((batch,), _F32) for path in taps}
+    contribs = jax.grad(loss_fn)(acc0)
+    norm_sq = jnp.zeros(batch, _F32)
+    for c in contribs.values():
+        norm_sq = norm_sq + c
+    return jnp.sqrt(norm_sq) * mask
+
+
 def batched_grand_scores(model, variables, image, label, mask,
                          use_pallas: bool = False) -> jax.Array:
     """Exact per-example GraNd over all parameters, fully batched. [B] <- batch.
@@ -422,20 +540,7 @@ def batched_grand_scores(model, variables, image, label, mask,
         loss = jnp.sum(cross_entropy(logits, label) * mask)
         return loss, mut["ddt_in"]
 
-    # Completeness: every parameter must belong to an intercepted layer —
-    # otherwise its gradient would be silently missing from the norm (unlike the
-    # loud NotImplementedErrors for grouped/dilated convs). Conservative by
-    # design: a parameterized-but-unused module also trips this (its true
-    # contribution is zero, but we cannot tell "unused" from "missed" here).
-    covered = {rec["path"] for rec in records}
-    for path, _ in jax.tree_util.tree_flatten_with_path(
-            variables.get("params", {}))[0]:
-        mod_path = tuple(p.key for p in path[:-1])
-        if mod_path not in covered:
-            raise NotImplementedError(
-                f"batched GraNd: parameters at {'/'.join(mod_path)} belong to a "
-                "module type the interceptor does not cover (only Conv/Dense/"
-                "BatchNorm are); use the grand_vmap score method")
+    _check_covered(records, variables)
 
     cotangents, captures = jax.grad(loss_fn, has_aux=True)(perts0)
 

@@ -237,13 +237,23 @@ def make_grand_batched_step(model, mesh: Mesh | None = None,
     MXU sees large batched matmuls instead of batch-1 convolutions. Eval-mode
     only (train-mode BatchNorm couples examples; see the module docstring).
     ``use_pallas`` selects the fused conv-grad-norm kernel for the large-S
-    conv layers (None = auto: on for TPU backends)."""
-    from .grand_batched import batched_grand_scores
+    conv layers (None = auto: on for TPU backends). ``DDT_GRAND_FUSED=1``
+    routes through ``batched_grand_scores_fused`` (contractions inside the
+    backward pass) instead of the two-phase composition."""
+    from . import grand_batched
     use_pallas = resolve_use_pallas(use_pallas)
+    # Module-attribute access (not by-name import): the toggle is resolved at
+    # factory-call time. Only env-pinned subprocesses can rely on it — this
+    # factory is functools.cache'd, so in-process patching of FUSED_BWD after
+    # a first call returns the previously-cached path (tests call the score
+    # functions directly for exactly that reason; see tests/test_grand_batched.py).
+    score_fn = (grand_batched.batched_grand_scores_fused
+                if grand_batched.FUSED_BWD
+                else grand_batched.batched_grand_scores)
 
     def local_scores(variables, image, label, mask):
-        return batched_grand_scores(model, variables, image, label, mask,
-                                    use_pallas=use_pallas)
+        return score_fn(model, variables, image, label, mask,
+                        use_pallas=use_pallas)
 
     return _wrap(local_scores, mesh)
 

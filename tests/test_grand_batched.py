@@ -110,6 +110,75 @@ def test_batched_with_pallas_kernels_matches_vmap_wide_channels():
                                rtol=2e-4, atol=1e-5)
 
 
+# NOTE: the fused tests call batched_grand_scores_fused DIRECTLY (the
+# test_grouped_dispatch_matches_ungrouped pattern) — make_grand_batched_step is
+# functools.cache'd and flax modules compare by config, so routing through the
+# step factory after monkeypatching FUSED_BWD would return whichever path a
+# prior test cached and the assertion would be vacuous.
+@pytest.mark.parametrize("arch,hw", [("tiny_cnn", 16), ("resnet18", 16),
+                                     ("resnet50", 8)])
+def test_fused_bwd_matches_vmap(arch, hw):
+    """The fused-backward variant (contractions inside the bwd pass via
+    custom_vjp taps, DDT_GRAND_FUSED) computes the identical quantity."""
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+    model = create_model(arch, 10)
+    batch = _batch(8, hw, seed=5)
+    variables = _trained_stats(model, _init(model, hw), batch)
+    fused = batched_grand_scores_fused(model, variables, batch["image"],
+                                       batch["label"], batch["mask"])
+    ref = make_grand_step(model, chunk=4)(variables, batch)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_fused_bwd_matches_vmap_wideresnet():
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+    model = WideResNet(depth=10, widen_factor=1, num_classes=10)
+    batch = _batch(6, 16, seed=6)
+    variables = _trained_stats(model, _init(model, 16), batch)
+    fused = batched_grand_scores_fused(model, variables, batch["image"],
+                                       batch["label"], batch["mask"])
+    ref = make_grand_step(model, chunk=3)(variables, batch)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_fused_bwd_masked_rows_and_refusal():
+    """Fused path masks like the two-phase path, shares its coverage guard,
+    and refuses the grouping toggles it does not implement."""
+    from data_diet_distributed_tpu.ops import grand_batched
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+    model = create_model("tiny_cnn", 10)
+    batch = _batch(8, 16, seed=7)
+    batch["mask"][5:] = 0.0
+    variables = _init(model, 16)
+    scores = np.asarray(batched_grand_scores_fused(
+        model, variables, batch["image"], batch["label"], batch["mask"]))
+    assert (scores[5:] == 0).all() and (scores[:5] > 0).all()
+
+    class WithGroupNorm(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.GroupNorm(num_groups=2)(x)   # parameterized, not intercepted
+            return nn.Dense(10)(jnp.mean(x, axis=(1, 2)))
+
+    gn = WithGroupNorm()
+    gn_vars = _init(gn, 16)
+    with pytest.raises(NotImplementedError, match="grand_vmap"):
+        batched_grand_scores_fused(gn, gn_vars, batch["image"],
+                                   batch["label"], batch["mask"])
+
+    import unittest.mock as mock
+    with mock.patch.object(grand_batched, "USE_BN_KERNEL", True), \
+            pytest.raises(ValueError, match="incompatible"):
+        batched_grand_scores_fused(model, variables, batch["image"],
+                                   batch["label"], batch["mask"])
+
+
 def test_masked_rows_score_zero():
     model = create_model("tiny_cnn", 10)
     batch = _batch(8, 16, seed=1)

@@ -29,7 +29,8 @@ import sys
 # did in round 5), and an unpinned combo would silently inherit them —
 # "baseline" must always measure the all-off program.
 _ALL_OFF = {f"DDT_GRAND_{k}": "0" for k in
-            ("GROUP_CONV", "GROUP_BN", "BN_KERNEL", "CATDOT", "STEM_XLA")}
+            ("GROUP_CONV", "GROUP_BN", "BN_KERNEL", "CATDOT", "STEM_XLA",
+             "FUSED")}
 
 
 def _combo(*on: str) -> dict:
@@ -45,9 +46,11 @@ COMBOS = [
     ("group_conv", _combo("GROUP_CONV")),
     ("stem_xla", _combo("STEM_XLA")),
     ("bn_kernel+catdot+stem_xla", _combo("BN_KERNEL", "CATDOT", "STEM_XLA")),
+    ("fused", _combo("FUSED")),
+    ("fused+stem_xla", _combo("FUSED", "STEM_XLA")),
 ]
 
-FAST = ("baseline", "bn_kernel", "catdot", "bn_kernel+catdot")
+FAST = ("baseline", "stem_xla", "fused", "fused+stem_xla")
 
 
 def main():

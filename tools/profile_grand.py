@@ -73,6 +73,13 @@ def main():
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--no-pallas", action="store_true")
     args = ap.parse_args()
+    if gb.FUSED_BWD:
+        # This tool times the TWO-PHASE program (it calls
+        # batched_grand_scores directly); under DDT_GRAND_FUSED=1 every
+        # reported number would describe a program the operator isn't running.
+        raise SystemExit("profile_grand times the two-phase path; unset "
+                         "DDT_GRAND_FUSED (fused-path A/Bs live in bench.py / "
+                         "tools/bisect_grand.py)")
     use_pallas = not args.no_pallas
 
     model = create_model(args.arch, args.classes, half_precision=True)
