@@ -28,6 +28,15 @@ class DataConfig:
     batch_size: int = 128             # global batch size (reference: config.yaml:7)
     eval_batch_size: int = 500        # reference hardcodes 100 (data/loader.py:41)
     synthetic_size: int = 2048        # train-set size for the synthetic datasets
+    # Per-pixel noise std for the synthetic datasets (class templates have std
+    # 0.5). The default is easily separable; raise it to make the task hard
+    # enough that pruning visibly costs accuracy (e2e sweep demonstrations).
+    synthetic_noise: float = 0.4
+    # clusters > 1 makes each synthetic class a Zipf-weighted mixture of that
+    # many templates — a heavy-tailed task whose sample complexity is real:
+    # rare clusters are hard informative examples (the regime pruning is FOR).
+    # 1 = the historical single-template stream, bit-identical.
+    synthetic_clusters: int = 1
     shuffle_each_epoch: bool = True   # reference bug 2.4.6: DDP reshuffle never happened
     # On-device training augmentation (random crop + flip inside the jitted
     # train step — data/augment.py). The reference trains un-augmented
@@ -221,6 +230,13 @@ class Config:
                 "and cannot start from score.score_ckpt_step; unset one of them")
         if self.data.crop_pad < 0:
             raise ValueError(f"data.crop_pad must be >= 0, got {self.data.crop_pad}")
+        if self.data.synthetic_noise <= 0:
+            raise ValueError(
+                f"data.synthetic_noise must be > 0, got {self.data.synthetic_noise}")
+        if self.data.synthetic_clusters < 1:
+            raise ValueError(
+                f"data.synthetic_clusters must be >= 1, got "
+                f"{self.data.synthetic_clusters}")
         if self.optim.warmup_epochs < 0:
             raise ValueError(
                 f"optim.warmup_epochs must be >= 0, got {self.optim.warmup_epochs}")

@@ -176,25 +176,52 @@ def _normalize(x_uint8: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.nda
 
 
 def _synthetic(size: int, num_classes: int, seed: int, split: str,
-               image_size: int = 32):
+               image_size: int = 32, noise: float = 0.4, clusters: int = 1):
     """Deterministic class-structured fake data: each class gets a fixed template plus
     noise, so models can actually learn and pruning scores are non-degenerate. The
     templates depend only on ``seed`` — train and test splits share them (different
-    noise), so generalization is measurable."""
+    noise), so generalization is measurable.
+
+    ``noise`` (std, vs template std 0.5) sets the per-pixel SNR. ``clusters`` sets
+    the SAMPLE COMPLEXITY: with ``clusters > 1`` each class is a Zipf-weighted
+    mixture of that many templates, so a model must *cover* the cluster tail to
+    classify the (identically-distributed) test split — rare clusters are genuinely
+    hard, informative examples. That is the regime data pruning exists for:
+    keep-hardest retains tail coverage that keep-random destroys. The default
+    ``clusters=1`` branch reproduces the historical single-template stream
+    bit-for-bit (cross-framework score artifacts were computed on it)."""
     template_rng = np.random.default_rng(np.random.SeedSequence([seed, 0xD1E7]))
-    # Two signal components: a spatial template (rich per-example score structure) and
-    # a per-channel signature (survives global average pooling, so GAP-headed conv
-    # nets separate classes within a few optimizer steps).
-    templates = template_rng.normal(
-        0.0, 0.5, size=(num_classes, image_size, image_size, 3)).astype(np.float32)
-    channel_sig = template_rng.normal(
-        0.0, 1.0, size=(num_classes, 1, 1, 3)).astype(np.float32)
     rng = np.random.default_rng(
         np.random.SeedSequence([seed, 1 if split == "train" else 2]))
+    if clusters == 1:
+        # Two signal components: a spatial template (rich per-example score
+        # structure) and a per-channel signature (survives global average pooling,
+        # so GAP-headed conv nets separate classes within a few optimizer steps).
+        templates = template_rng.normal(
+            0.0, 0.5, size=(num_classes, image_size, image_size, 3)).astype(np.float32)
+        channel_sig = template_rng.normal(
+            0.0, 1.0, size=(num_classes, 1, 1, 3)).astype(np.float32)
+        labels = rng.integers(0, num_classes, size=size).astype(np.int32)
+        pixel_noise = rng.normal(
+            0.0, noise, size=(size, image_size, image_size, 3)).astype(np.float32)
+        images = templates[labels] + channel_sig[labels] + pixel_noise
+        return images, labels
+    # Mixture branch: per-(class, cluster) spatial templates; the channel
+    # signature is per CLUSTER INDEX (shared across classes), so global channel
+    # means identify the cluster but NOT the class — classification requires
+    # having learned the spatial template of each cluster the test set draws.
+    templates = template_rng.normal(
+        0.0, 0.5,
+        size=(num_classes, clusters, image_size, image_size, 3)).astype(np.float32)
+    channel_sig = template_rng.normal(
+        0.0, 1.0, size=(clusters, 1, 1, 3)).astype(np.float32)
+    weights = 1.0 / np.arange(1, clusters + 1) ** 1.1
+    weights /= weights.sum()
     labels = rng.integers(0, num_classes, size=size).astype(np.int32)
-    noise = rng.normal(
-        0.0, 0.4, size=(size, image_size, image_size, 3)).astype(np.float32)
-    images = templates[labels] + channel_sig[labels] + noise
+    cluster_of = rng.choice(clusters, size=size, p=weights).astype(np.int32)
+    pixel_noise = rng.normal(
+        0.0, noise, size=(size, image_size, image_size, 3)).astype(np.float32)
+    images = templates[labels, cluster_of] + channel_sig[cluster_of] + pixel_noise
     return images, labels
 
 
@@ -321,7 +348,8 @@ def _load_npy_mmap(data_dir: str):
 
 
 def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2048,
-                 seed: int = 0) -> tuple[ArrayDataset, ArrayDataset]:
+                 seed: int = 0, synthetic_noise: float = 0.4,
+                 synthetic_clusters: int = 1) -> tuple[ArrayDataset, ArrayDataset]:
     """Return ``(train, test)`` ArrayDatasets (reference: ``data/loader.py:27-43``)."""
     if dataset == "npz" and has_npy_splits(data_dir):
         arrays, norm = _load_npy_mmap(data_dir)
@@ -335,15 +363,22 @@ def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2
 
         return (make_lazy(*arrays["train"]), make_lazy(*arrays["test"]))
     if dataset == "synthetic":
-        train_x, train_y = _synthetic(synthetic_size, 10, seed, "train")
-        test_x, test_y = _synthetic(max(synthetic_size // 4, 64), 10, seed, "test")
+        train_x, train_y = _synthetic(synthetic_size, 10, seed, "train",
+                                      noise=synthetic_noise,
+                                      clusters=synthetic_clusters)
+        test_x, test_y = _synthetic(max(synthetic_size // 4, 64), 10, seed, "test",
+                                    noise=synthetic_noise,
+                                    clusters=synthetic_clusters)
         num_classes = 10
     elif dataset == "synthetic_imagenet":
         # ImageNet-geometry stand-in: 96x96, 100 classes. Exercises the ResNet-50
         # large-input path (BASELINE config 5) without the real dataset.
-        train_x, train_y = _synthetic(synthetic_size, 100, seed, "train", 96)
+        train_x, train_y = _synthetic(synthetic_size, 100, seed, "train", 96,
+                                      noise=synthetic_noise,
+                                      clusters=synthetic_clusters)
         test_x, test_y = _synthetic(max(synthetic_size // 4, 100), 100, seed,
-                                    "test", 96)
+                                    "test", 96, noise=synthetic_noise,
+                                    clusters=synthetic_clusters)
         num_classes = 100
     elif dataset == "npz":
         (train_x, train_y), (test_x, test_y) = _load_npz(data_dir)

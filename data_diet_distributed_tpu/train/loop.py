@@ -342,7 +342,9 @@ def load_data_for(cfg: Config):
     only know it after reading labels)."""
     from ..data.datasets import load_dataset
     train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
-                                     cfg.data.synthetic_size, seed=cfg.train.seed)
+                                     cfg.data.synthetic_size, seed=cfg.train.seed,
+                                     synthetic_noise=cfg.data.synthetic_noise,
+                                     synthetic_clusters=cfg.data.synthetic_clusters)
     cfg.model.num_classes = train_ds.num_classes
     return train_ds, test_ds
 

@@ -34,6 +34,10 @@ def test_invalid_values_rejected():
         load_config(None, ["score.method=gradient"])
     with pytest.raises(ValueError):
         load_config(None, ["data.dataset=imagenet99"])
+    with pytest.raises(ValueError, match="synthetic_noise"):
+        load_config(None, ["data.synthetic_noise=0"])
+    with pytest.raises(ValueError, match="synthetic_clusters"):
+        load_config(None, ["data.synthetic_clusters=0"])
 
 
 def test_yaml_roundtrip(tmp_path):

@@ -82,6 +82,12 @@ def main() -> None:
                         help="dense epochs before the scoring pass (the "
                              "reference scores at ~10%% of its recipe)")
     parser.add_argument("--arch", default="resnet18")
+    parser.add_argument("--noise", type=float, default=0.4,
+                        help="data.synthetic_noise (per-pixel std)")
+    parser.add_argument("--clusters", type=int, default=1,
+                        help="data.synthetic_clusters (>1: Zipf mixture per "
+                             "class — the sample-starved regime where pruning "
+                             "policy matters; 1 is ceiling-easy at 50k)")
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--lr", type=float, default=0.05)
     parser.add_argument("--score-method", default="el2n")
@@ -123,6 +129,8 @@ def main() -> None:
 
     common = [
         "data.dataset=synthetic", f"data.synthetic_size={args.size}",
+        f"data.synthetic_noise={args.noise}",
+        f"data.synthetic_clusters={args.clusters}",
         f"data.batch_size={args.batch}", f"data.eval_batch_size={args.batch}",
         f"model.arch={args.arch}", f"optim.lr={args.lr}",
         f"train.num_epochs={args.epochs}",
