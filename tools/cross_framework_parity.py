@@ -35,7 +35,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def jax_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
+def _atomic_savez(path: str, **arrays) -> None:
+    """Write-then-rename: a kill mid-save must not destroy prior checkpoints."""
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def jax_scores_per_seed(args, train_ds, method: str,
+                        on_seed=None) -> list[np.ndarray]:
     """One independently-pretrained scoring run per seed, through the
     production compute_scores driver (seeds=[s] isolates each trajectory)."""
     from data_diet_distributed_tpu.config import load_config
@@ -62,10 +70,13 @@ def jax_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
                                    sharder=BatchSharder(mesh),
                                    logger=MetricsLogger(None, echo=False))
         out.append(np.asarray(scores, np.float64))
+        if on_seed is not None:
+            on_seed(s, out)
     return out
 
 
-def torch_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
+def torch_scores_per_seed(args, train_ds, method: str,
+                          on_seed=None) -> list[np.ndarray]:
     import torch
 
     from oracle import (TORCH_MIRRORS, torch_el2n, torch_grand,
@@ -89,6 +100,8 @@ def torch_scores_per_seed(args, train_ds, method: str) -> list[np.ndarray]:
         else:
             scores = torch_grand(model, x_nchw, y_t)
         out.append(np.asarray(scores, np.float64))
+        if on_seed is not None:
+            on_seed(s, out)
     return out
 
 
@@ -126,13 +139,29 @@ def main() -> None:
         "config": np.array(json.dumps(vars(args))),
     }
     summary: dict[str, float] = {}
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     for method in args.methods:
-        jx = jax_scores_per_seed(args, train_ds, method)
-        th = torch_scores_per_seed(args, train_ds, method)
+        # A multi-hour run must survive being killed: every per-seed result is
+        # checkpointed into the artifact (atomically) the moment it exists — a
+        # 7-CPU-hour 10-seed ResNet-18 run once died to a wall-clock timeout
+        # with ALL results in memory and nothing on disk.
+        def save_partial(side, seed, partial, _method=method):
+            _atomic_savez(args.out, **payload,
+                          **{f"{side}_{_method}_partial": np.stack(partial)})
+            print(json.dumps({"partial": f"{side}_{_method} seed {seed}"}),
+                  flush=True)
+
+        jx = jax_scores_per_seed(
+            args, train_ds, method,
+            on_seed=lambda s, p: save_partial("jax", s, p))
+        payload[f"jax_{method}"] = np.stack(jx)
+        _atomic_savez(args.out, **payload)
+        th = torch_scores_per_seed(
+            args, train_ds, method,
+            on_seed=lambda s, p: save_partial("torch", s, p))
         rho_cross = float(spearman(np.mean(jx, axis=0), np.mean(th, axis=0)))
         rho_within_jax = mean_pairwise_rho(jx)
         rho_within_torch = mean_pairwise_rho(th)
-        payload[f"jax_{method}"] = np.stack(jx)
         payload[f"torch_{method}"] = np.stack(th)
         payload[f"rho_cross_{method}"] = np.float64(rho_cross)
         payload[f"rho_within_jax_{method}"] = np.float64(rho_within_jax)
@@ -140,9 +169,10 @@ def main() -> None:
         summary[f"rho_cross_{method}"] = round(rho_cross, 4)
         summary[f"rho_within_jax_{method}"] = round(rho_within_jax, 4)
         summary[f"rho_within_torch_{method}"] = round(rho_within_torch, 4)
+        _atomic_savez(args.out, **payload)
+        print(json.dumps({"partial": method, **summary}), flush=True)
 
-    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-    np.savez(args.out, **payload)
+    _atomic_savez(args.out, **payload)
     summary.update(out=args.out, n=args.size, epochs=args.epochs,
                    seeds=len(args.seeds), arch=args.arch)
     print(json.dumps(summary))

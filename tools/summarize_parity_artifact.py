@@ -1,0 +1,60 @@
+"""Summarize a cross-framework parity artifact (complete OR partial).
+
+Prints one JSON line with cross-framework Spearman rho of seed-averaged
+scores plus within-framework floors, working from whatever seeds the
+artifact holds — including the ``torch_<method>_partial`` checkpoints the
+tool saves per torch seed, so a wall-clock-killed run still yields its
+measured number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from cross_framework_parity import mean_pairwise_rho  # noqa: E402
+from data_diet_distributed_tpu.utils.stats import spearman  # noqa: E402
+
+
+def main() -> None:
+    path = sys.argv[1]
+    out: dict = {"artifact": path}
+    with np.load(path) as d:
+        cfg = json.loads(str(d["config"]))
+        out.update(arch=cfg["arch"], size=cfg["size"], epochs=cfg["epochs"],
+                   seeds=cfg["seeds"])
+        files = set(d.files)
+
+        def pick(*names):
+            # NpzFile.get needs numpy>=1.25; membership checks work everywhere.
+            for n in names:
+                if n in files:
+                    return d[n]
+            return None
+
+        for method in cfg["methods"]:
+            jx = pick(f"jax_{method}", f"jax_{method}_partial")
+            th = pick(f"torch_{method}", f"torch_{method}_partial")
+            if jx is None or th is None:
+                out[method] = "missing"
+                continue
+            out[f"rho_cross_{method}"] = round(
+                float(spearman(jx.mean(axis=0), th.mean(axis=0))), 4)
+            out[f"rho_within_jax_{method}"] = round(
+                mean_pairwise_rho(list(jx)), 4)
+            out[f"rho_within_torch_{method}"] = round(
+                mean_pairwise_rho(list(th)), 4)
+            out[f"n_jax_seeds_{method}"] = int(jx.shape[0])
+            out[f"n_torch_seeds_{method}"] = int(th.shape[0])
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
