@@ -13,8 +13,10 @@ v4 chips ~ 2 083 examples/sec/chip).
 Backend hardening: this image reaches its TPU through a loopback relay that has a
 known wedge mode — a fresh client's device claim can hang indefinitely after an
 earlier process was killed mid-init. ``jax.devices()`` is therefore probed in a
-bounded SUBPROCESS (a hang cannot be timed out in-process) with retry + backoff
-before the in-process backend ever initializes.
+bounded SUBPROCESS (a hang cannot be timed out in-process) with retry +
+exponential backoff before the in-process backend ever initializes — the probe
+is the resilience watchdog's (``data_diet_distributed_tpu/resilience/
+watchdog.py``), shared with the CLI's ``resilience.init_probe``.
 
 Run: ``python bench.py [--size N] [--batch B] [--method grand|el2n] [--arch A]
 [--mesh DxM]``
@@ -24,11 +26,14 @@ from __future__ import annotations
 
 import argparse
 import json
-import subprocess
-import sys
 import time
 
 import numpy as np
+
+# Importable before backend init by design (see resilience/__init__.py) — the
+# probe must run while no in-process device claim exists yet.
+from data_diet_distributed_tpu.resilience.watchdog import \
+    probe_devices as probe_backend
 
 
 NORTH_STAR_EXAMPLES_PER_SEC = 8333.0   # 50k x 10 seeds / 60 s
@@ -39,49 +44,11 @@ NORTH_STAR_CHIPS = 4.0                 # v4-8 = 4 dual-core chips
 # budget = 2083 * 3.2 / 3.
 TRAIN_BUDGET_PER_CHIP = (NORTH_STAR_EXAMPLES_PER_SEC / NORTH_STAR_CHIPS) * 3.2 / 3
 
-PROBE_SNIPPET = (
-    "import jax, json; ds = jax.devices(); "
-    "print(json.dumps({'n': len(ds), 'platform': ds[0].platform}))"
-)
-
-
 def emit(metric: str, value: float, unit: str, vs_baseline: float, **extra) -> None:
     line = {"metric": metric, "value": value, "unit": unit,
             "vs_baseline": vs_baseline}
     line.update(extra)
     print(json.dumps(line), flush=True)
-
-
-def probe_backend(attempts: int = 3, timeout_s: float = 150.0) -> dict | None:
-    """Check that ``jax.devices()`` completes in a bounded subprocess.
-
-    Returns the probe info dict on success, or a failure-description dict with an
-    ``"error"`` key after ``attempts`` tries. Each retry backs off (20 s, 40 s) —
-    the relay's transient claim-contention (a previous holder still exiting)
-    resolves in seconds; the hard wedge does not resolve at all, which is exactly
-    what the bounded timeout converts into a parseable failure instead of a hang.
-    """
-    last_err = "unknown"
-    for attempt in range(attempts):
-        if attempt:
-            time.sleep(20.0 * attempt)
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", PROBE_SNIPPET],
-                capture_output=True, text=True, timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            last_err = (f"backend probe hung >{timeout_s:.0f}s "
-                        "(device-claim wedge)")
-            continue
-        if proc.returncode == 0:
-            try:
-                return json.loads(proc.stdout.strip().splitlines()[-1])
-            except (ValueError, IndexError):
-                last_err = f"probe emitted unparseable output: {proc.stdout[-200:]}"
-                continue
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        last_err = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
-    return {"error": f"backend init failed after {attempts} attempts: {last_err}"}
 
 
 def parse_mesh(spec: str | None):

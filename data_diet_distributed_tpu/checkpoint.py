@@ -18,6 +18,9 @@ from typing import TYPE_CHECKING, Any
 import jax
 import orbax.checkpoint as ocp
 
+from .resilience.integrity import (CheckpointCorrupt, build_manifest,
+                                   verify_restored)
+
 if TYPE_CHECKING:  # avoid a circular import (train.loop uses this module)
     from .train.state import TrainState
 
@@ -25,6 +28,7 @@ if TYPE_CHECKING:  # avoid a circular import (train.loop uses this module)
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 20):
         directory = os.path.abspath(directory)
+        self.directory = directory
         if jax.process_index() == 0:
             os.makedirs(directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
@@ -38,9 +42,16 @@ class CheckpointManager:
              metrics: dict[str, Any] | None = None) -> None:
         payload = {"params": state.params, "batch_stats": state.batch_stats,
                    "opt_state": state.opt_state, "step": state.step}
-        composite = {"state": ocp.args.StandardSave(payload)}
+        composite = {"state": ocp.args.StandardSave(payload),
+                     # Integrity manifest (leaf paths/shapes/dtypes, step,
+                     # params finite-ness) rides in the same composite — atomic
+                     # with the state it describes; restore_verified checks it.
+                     "manifest": ocp.args.JsonSave(build_manifest(payload, step))}
         if metrics:
-            composite["metrics"] = ocp.args.JsonSave(metrics)
+            # Item name "meta", NOT "metrics": CheckpointManager reserves
+            # "metrics" for its own best-checkpoint tracking
+            # (orbax RESERVED_ITEM_NAMES) — using it makes every save raise.
+            composite["meta"] = ocp.args.JsonSave(metrics)
         # Saves are ASYNC: serialization overlaps the next epoch's compute
         # (Orbax snapshots the arrays before returning, so donation/mutation of
         # ``state`` afterwards is safe). Any still-running previous save is
@@ -84,6 +95,55 @@ class CheckpointManager:
                              opt_state=payload["opt_state"],
                              step=payload["step"])
 
+    def manifest(self, step: int) -> dict[str, Any] | None:
+        """The integrity manifest saved alongside a step (None for checkpoints
+        written before manifests existed — those stay restorable unverified)."""
+        self._mngr.wait_until_finished()
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.Composite(manifest=ocp.args.JsonRestore()))
+            return restored["manifest"]
+        except KeyError:    # pre-manifest checkpoint — a legitimate None;
+            return None     # real IO/corruption errors propagate
+
+    def restore_verified(self, state: "TrainState", step: int | None = None,
+                         on_fallback=None) -> tuple["TrainState", int]:
+        """Restore the newest durable step that passes manifest verification.
+
+        Candidates are every durable step (``<= step`` when one is pinned —
+        the recovery path pins its own latest save, and falling back FORWARD
+        to a newer stale checkpoint would resume someone else's run), newest
+        first. A candidate that fails — Orbax deserialization of a truncated
+        payload, or manifest drift (``resilience/integrity.py``) — is reported
+        via ``on_fallback(step=, error=)`` and the next-oldest is tried;
+        ``CheckpointCorrupt`` is raised only when every candidate fails.
+
+        Returns ``(state, restored_step)`` so the caller reads epoch metadata
+        for the step actually used, not the one it asked for.
+        """
+        candidates = [s for s in sorted(self.all_steps(), reverse=True)
+                      if step is None or s <= step]
+        if not candidates:
+            raise FileNotFoundError("no checkpoint to restore")
+        last_err: Exception | None = None
+        for s in candidates:
+            try:
+                restored = self.restore(state, s)
+                verify_restored(
+                    {"params": restored.params,
+                     "batch_stats": restored.batch_stats,
+                     "opt_state": restored.opt_state, "step": restored.step},
+                    self.manifest(s), step=s)
+                return restored, s
+            except Exception as err:  # noqa: BLE001 — any failed candidate falls back
+                last_err = err
+                if on_fallback is not None:
+                    on_fallback(step=s, error=repr(err)[:300])
+        raise CheckpointCorrupt(
+            f"all {len(candidates)} durable checkpoint(s) "
+            f"{candidates} failed restore/verification; last error: "
+            f"{last_err!r}") from last_err
+
     def metrics(self, step: int | None = None) -> dict[str, Any] | None:
         """The metrics JSON saved alongside a step (None if absent) — carries
         the epoch counter, so resume does not have to derive it from
@@ -95,8 +155,8 @@ class CheckpointManager:
             return None
         try:
             restored = self._mngr.restore(
-                step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore()))
-            return restored["metrics"]
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+            return restored["meta"]
         except KeyError:    # saved without a metrics item — a legitimate None;
             return None     # real IO/corruption errors propagate
 

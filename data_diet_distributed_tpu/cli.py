@@ -40,6 +40,26 @@ def main(argv: list[str] | None = None) -> int:
     import time
     run_started = time.time()
     command, cfg = _build(sys.argv[1:] if argv is None else argv)
+    from .resilience import inject
+    from .resilience.preemption import EXIT_PREEMPTED, Preempted
+    plan = inject.activate_from_env()
+    if plan is not None:
+        print(f"[resilience] fault plan armed from DDT_FAULT_PLAN: {plan}",
+              flush=True)
+    if cfg.resilience.init_probe and not cfg.mesh.multihost:
+        # Watchdog-wrapped backend init: jax.devices() in a killable
+        # subprocess with retry + backoff, BEFORE the in-process claim — the
+        # device-claim wedge becomes a distinct exit status, not a hang.
+        # Skipped under multihost (same as bench.py): the probe subprocess
+        # has no jax.distributed rendezvous, so it would try to claim the
+        # full slice single-process and fail a healthy multi-host job.
+        from .resilience.watchdog import probe_devices
+        info = probe_devices(cfg.resilience.probe_attempts,
+                             cfg.resilience.probe_timeout_s,
+                             cfg.resilience.probe_backoff_s)
+        if "error" in info:
+            print(f"[resilience] {info['error']}", file=sys.stderr, flush=True)
+            return 69   # EX_UNAVAILABLE: backend wedged before any claim
     from .parallel.mesh import initialize_multihost
     initialize_multihost(cfg.mesh)
 
@@ -48,13 +68,22 @@ def main(argv: list[str] | None = None) -> int:
         monitor.start()
     logger = MetricsLogger(cfg.obs.metrics_path)
     from .obs import trace
+    preempted: Preempted | None = None
     try:
         with trace(cfg.obs.profile_dir):
             _dispatch(command, cfg, logger)
+    except Preempted as p:
+        # Clean preemption exit: the final checkpoint is durable and the
+        # "preempted" event is already in the metrics JSONL — report the exact
+        # resume point and a status a supervisor can branch on.
+        preempted = p
     finally:
         logger.close()
         if monitor:
             monitor.stop()
+    if preempted is not None:
+        print(f"[preempted] {preempted}", flush=True)
+        return EXIT_PREEMPTED
     if cfg.obs.plots_dir:
         import jax
         if jax.process_index() == 0:

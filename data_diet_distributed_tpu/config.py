@@ -185,6 +185,44 @@ class MeshConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault-tolerance layer (``resilience/``): watchdog, preemption handling,
+    checkpoint integrity, NaN sentinel. The reference has none of it — a hung
+    or evicted worker lost the whole job (SURVEY §5.3)."""
+
+    # Heartbeat deadline over training progress units: each step, the epoch
+    # metrics fetch, the eval pass, the epoch hook, and the checkpoint save
+    # each get a fresh deadline; a unit that makes no host-side progress for
+    # this long raises a retriable WatchdogTimeout instead of hanging forever.
+    # None = off (the deadline must be sized to the slowest legitimate unit,
+    # compile and a full eval pass included — no universal default).
+    step_timeout_s: float | None = None
+    # SIGTERM/SIGINT -> final synchronous checkpoint -> Preempted (CLI exit
+    # 75); rerun with train.resume=true to continue.
+    preemption: bool = True
+    # Verify restored checkpoints against their save-time manifest
+    # (step/shape/dtype/finite-ness) and fall back to the newest earlier
+    # durable step when the latest is corrupt.
+    verify_restore: bool = True
+    # Raise on NaN/inf epoch loss BEFORE the diverged state is checkpointed...
+    nan_check: bool = True
+    # ...then roll back to the last good checkpoint and retry with
+    # lr *= nan_lr_factor, up to nan_retry_budget times (its own budget:
+    # replaying the same LR would diverge identically, so divergence retries
+    # are not generic crash retries).
+    nan_retry_budget: int = 1
+    nan_lr_factor: float = 0.5
+    # Subprocess-bounded `jax.devices()` probe with retry + exponential
+    # backoff BEFORE the in-process backend init — converts the device-claim
+    # wedge into a parseable failure. Off by default for the CLI (CPU/test
+    # runs skip the subprocess); bench.py always probes unless --no-probe.
+    init_probe: bool = False
+    probe_attempts: int = 3
+    probe_timeout_s: float = 150.0
+    probe_backoff_s: float = 20.0
+
+
+@dataclass
 class ObsConfig:
     """Observability (reference: prints + ``ddp_new.py:21-99`` sidecar monitor)."""
 
@@ -205,6 +243,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def validate(self) -> "Config":
         if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
@@ -256,6 +295,22 @@ class Config:
             self.model.num_classes = self.data.num_classes
         if self.data.batch_size <= 0 or self.train.num_epochs < 0:
             raise ValueError("batch_size must be positive, num_epochs non-negative")
+        r = self.resilience
+        if r.step_timeout_s is not None and r.step_timeout_s <= 0:
+            raise ValueError(
+                f"resilience.step_timeout_s must be > 0 (or null to disable "
+                f"the watchdog), got {r.step_timeout_s}")
+        if r.nan_retry_budget < 0:
+            raise ValueError(
+                f"resilience.nan_retry_budget must be >= 0, got {r.nan_retry_budget}")
+        if not 0.0 < r.nan_lr_factor <= 1.0:
+            raise ValueError(
+                f"resilience.nan_lr_factor must be in (0, 1], got {r.nan_lr_factor}")
+        if r.probe_attempts < 1 or r.probe_timeout_s <= 0 or r.probe_backoff_s < 0:
+            raise ValueError(
+                "resilience probe settings need probe_attempts >= 1, "
+                "probe_timeout_s > 0, probe_backoff_s >= 0; got "
+                f"{r.probe_attempts}/{r.probe_timeout_s}/{r.probe_backoff_s}")
         return self
 
 
@@ -280,6 +335,7 @@ _TYPE_MAP = {
     "DataConfig": DataConfig, "ModelConfig": ModelConfig, "OptimConfig": OptimConfig,
     "ScoreConfig": ScoreConfig, "PruneConfig": PruneConfig, "TrainConfig": TrainConfig,
     "MeshConfig": MeshConfig, "ObsConfig": ObsConfig,
+    "ResilienceConfig": ResilienceConfig,
 }
 
 

@@ -32,6 +32,16 @@ class MetricsLogger:
             body = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
             print(f"[{kind}] {body}", flush=True)
 
+    def fault(self, fault: str, **fields: Any) -> None:
+        """Structured fault event: ``{"kind": "fault", "fault": <class>, ...}``.
+
+        One schema for every failure class the resilience layer detects
+        (``hang``, ``step_exception``, ``divergence``, ``checkpoint_corrupt``)
+        so recovery tooling and tests filter on ``kind == "fault"`` instead of
+        scraping per-class event names; the matching ``recovery`` /
+        ``recovery_refused`` / ``preempted`` events share the JSONL stream."""
+        self.log("fault", fault=fault, **fields)
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()

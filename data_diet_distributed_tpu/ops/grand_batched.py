@@ -43,6 +43,7 @@ BASELINE.json north-star capability, and this is its TPU-native fast path.
 
 from __future__ import annotations
 
+from collections import Counter
 from functools import reduce
 
 import flax.linen as nn
@@ -389,7 +390,11 @@ def _bn_contrib(rec: dict, x: jax.Array, g: jax.Array, batch_stats) -> jax.Array
     axes = tuple(range(1, x.ndim - 1))
     gx = jnp.sum(g.astype(_F32) * x.astype(_F32), axis=axes)
     gs = jnp.sum(g.astype(_F32), axis=axes)
-    contrib = 0.0
+    # A well-shaped [B] zero, not Python 0.0: with use_scale=False and
+    # use_bias=False this IS the return value, and the fused path feeds it to
+    # custom_vjp as the cotangent of a [B] accumulator — a scalar there is a
+    # trace-time shape error.
+    contrib = jnp.zeros(x.shape[0], _F32)
     if rec["use_scale"]:
         contrib = contrib + jnp.sum(((gx - mean * gs) * rstd) ** 2, axis=-1)
     if rec["use_bias"]:
@@ -412,6 +417,22 @@ def _check_covered(records: list[dict], variables) -> None:
                 f"batched GraNd: parameters at {'/'.join(mod_path)} belong to a "
                 "module type the interceptor does not cover (only Conv/Dense/"
                 "BatchNorm are); use the grand_vmap score method")
+
+
+def _refuse_shared_modules(records: list[dict]) -> None:
+    """A module applied more than once in a single forward (weight sharing)
+    records its path per CALL but sows/taps per PATH — the per-path capture
+    keeps only the last call's input while the cotangent sums across calls, so
+    both batched algorithms would silently compute the wrong per-layer
+    contribution. Same loud-refusal policy as grouped/dilated convs."""
+    counts = Counter(rec["path"] for rec in records)
+    dupes = sorted("/".join(p) for p, c in counts.items() if c > 1)
+    if dupes:
+        raise NotImplementedError(
+            f"batched GraNd: module(s) applied more than once per forward "
+            f"({dupes}): weight sharing needs the gradient SUMMED across "
+            "calls before the norm, which the per-path taps cannot express; "
+            "use the grand_vmap score method")
 
 
 def batched_grand_scores_fused(model, variables, image, label, mask,
@@ -451,6 +472,7 @@ def batched_grand_scores_fused(model, variables, image, label, mask,
     _check_covered(records, variables)
 
     batch_stats = variables.get("batch_stats", {})
+    _refuse_shared_modules(records)
     rec_by_path = {rec["path"]: rec for rec in records}
     batch = image.shape[0]
 
@@ -541,6 +563,7 @@ def batched_grand_scores(model, variables, image, label, mask,
         return loss, mut["ddt_in"]
 
     _check_covered(records, variables)
+    _refuse_shared_modules(records)
 
     cotangents, captures = jax.grad(loss_fn, has_aux=True)(perts0)
 

@@ -1,0 +1,33 @@
+"""Resilience: the fault-tolerance layer threaded through trainer/checkpoint/CLI/bench.
+
+The repo's own ledger motivates every piece: a device-claim wedge hung backend
+init with no watchdog (zeroed BENCH_r04/r05); a 7-CPU-hour run died to a
+wall-clock kill with no preemption handling; ``fit_with_recovery`` only caught
+raised exceptions — hangs, SIGTERM, corrupted checkpoints, and NaN losses all
+ended runs silently or fatally. Five mechanisms close those holes:
+
+==================  =========================================================
+watchdog.py         heartbeat deadline over training steps (hang ->
+                    retriable ``WatchdogTimeout``) + subprocess-bounded
+                    backend-init probe with retry/backoff (the bench wedge)
+preemption.py       SIGTERM/SIGINT -> final synchronous checkpoint ->
+                    ``Preempted`` / exit 75 (resume with train.resume=true)
+integrity.py        save-time pytree manifest, verified at restore;
+                    corruption falls back to the newest earlier durable step
+sentinel.py         NaN/inf epoch-loss detection BEFORE the state is
+                    checkpointed; recovery rolls back with reduced LR
+inject.py           deterministic fault injection for all of the above, so
+                    every recovery path is tested, not trusted
+==================  =========================================================
+
+Configured by the ``resilience:`` config block; events land in the metrics
+JSONL as structured ``fault`` / ``recovery`` / ``preempted`` /
+``checkpoint_fallback`` records. ``integrity`` is imported lazily by its users
+(it needs jax; everything here is importable before backend init — the probe
+depends on that).
+"""
+
+from . import inject  # noqa: F401
+from .preemption import EXIT_PREEMPTED, Preempted, PreemptionHandler  # noqa: F401
+from .sentinel import DivergenceError, LossSentinel  # noqa: F401
+from .watchdog import Watchdog, WatchdogTimeout, probe_devices  # noqa: F401

@@ -1,0 +1,164 @@
+"""Deterministic fault injection: every recovery path exercised, none trusted.
+
+Each failure class the resilience layer claims to handle is injectable at an
+exact, reproducible coordinate (a global step or epoch index), and each
+planned fault fires exactly ONCE — so a recovery retry replays the same
+training without re-tripping the fault, and "recovered to the uninjected
+result" is a pinnable assertion rather than a hope.
+
+Injection sites are threaded through the trainer as no-ops (a ``None``-plan
+check per call) and armed programmatically::
+
+    from data_diet_distributed_tpu.resilience import inject
+    inject.activate(inject.FaultPlan(hang_at=2, hang_seconds=60))
+    try:
+        fit_with_recovery(...)
+    finally:
+        inject.deactivate()
+
+or from the environment for manual ops drills:
+``DDT_FAULT_PLAN='{"sigterm_at_epoch_end": 0}' python -m ..cli train ...``.
+
+Fault classes: step exception, hang (interruptible sleep — what the watchdog
+must kill), SIGTERM to self (what preemption handling must catch), checkpoint
+truncation (what manifest verification must detect and fall back from), and a
+NaN epoch loss (what the sentinel must roll back from).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from dataclasses import dataclass, fields
+
+
+@dataclass
+class FaultPlan:
+    """One coordinate per fault class; ``None`` = that class is off.
+
+    ``*_at`` step coordinates are GLOBAL step indices within a fit
+    (``epoch * steps_per_epoch + i``); epoch coordinates are epoch indices.
+    """
+
+    step_exception_at: int | None = None   # raise RuntimeError before step N
+    hang_at: int | None = None             # sleep hang_seconds before step N
+    hang_seconds: float = 3600.0
+    sigterm_at_step: int | None = None     # SIGTERM self before step N (mid-epoch)
+    sigterm_at_epoch_end: int | None = None  # SIGTERM self after epoch N
+    truncate_after_save_step: int | None = None  # corrupt the ckpt saved at step N
+    nan_loss_at_epoch: int | None = None   # replace epoch N's train loss with NaN
+
+
+class FaultInjector:
+    def __init__(self):
+        self.plan: FaultPlan | None = None
+        self.fired: set[str] = set()
+
+    def _due(self, fault: str, coord) -> bool:
+        """True exactly once, when the plan arms ``fault`` at ``coord``."""
+        if self.plan is None or fault in self.fired:
+            return False
+        if getattr(self.plan, fault) != coord:
+            return False
+        self.fired.add(fault)
+        return True
+
+    def fire(self, site: str, **ctx) -> None:
+        if self.plan is None:
+            return
+        if site == "step":
+            step = ctx["step"]
+            if self._due("step_exception_at", step):
+                raise RuntimeError(
+                    f"injected step exception at global step {step}")
+            if self._due("hang_at", step):
+                # An interruptible hang: sleep holds no GIL-pinned native
+                # frame, so the watchdog's raising signal handler can break
+                # it — the same reach the watchdog has over real host-side
+                # stalls. (sleep does NOT resume after the handler raises;
+                # PEP 475 only restarts calls whose handler returns.)
+                time.sleep(self.plan.hang_seconds)
+            if self._due("sigterm_at_step", step):
+                os.kill(os.getpid(), signal.SIGTERM)
+        elif site == "epoch_end":
+            if self._due("sigterm_at_epoch_end", ctx["epoch"]):
+                os.kill(os.getpid(), signal.SIGTERM)
+        elif site == "checkpoint_saved":
+            if self._due("truncate_after_save_step", ctx["step"]):
+                # Barrier on the async save first: truncating a file that is
+                # still being written tests the writer, not the verifier.
+                ctx["manager"].all_steps()
+                truncate_checkpoint(ctx["directory"], ctx["step"])
+
+    def transform(self, site: str, value, **ctx):
+        if self.plan is not None and site == "epoch_loss" \
+                and self._due("nan_loss_at_epoch", ctx["epoch"]):
+            return float("nan")
+        return value
+
+
+_INJECTOR = FaultInjector()
+
+
+def activate(plan: FaultPlan) -> None:
+    _INJECTOR.plan = plan
+    _INJECTOR.fired = set()
+
+
+def deactivate() -> None:
+    _INJECTOR.plan = None
+    _INJECTOR.fired = set()
+
+
+def active_plan() -> FaultPlan | None:
+    return _INJECTOR.plan
+
+
+def fire(site: str, **ctx) -> None:
+    _INJECTOR.fire(site, **ctx)
+
+
+def transform(site: str, value, **ctx):
+    return _INJECTOR.transform(site, value, **ctx)
+
+
+def activate_from_env(env_var: str = "DDT_FAULT_PLAN") -> FaultPlan | None:
+    """Arm a plan from a JSON env var (manual ops drills); unknown keys refuse
+    loudly so a typo never silently disarms the drill."""
+    raw = os.environ.get(env_var)
+    if not raw:
+        return None
+    spec = json.loads(raw)
+    valid = {f.name for f in fields(FaultPlan)}
+    unknown = set(spec) - valid
+    if unknown:
+        raise ValueError(f"{env_var}: unknown fault plan keys {sorted(unknown)}; "
+                         f"valid: {sorted(valid)}")
+    plan = FaultPlan(**spec)
+    activate(plan)
+    return plan
+
+
+def truncate_checkpoint(directory: str, step: int) -> list[str]:
+    """Corrupt the durable checkpoint at ``step`` by truncating its largest
+    payload file to a third — the on-disk signature of a write cut off by a
+    kill/eviction. Returns the paths truncated (refuses if none found, so a
+    layout change can never make the injection silently test nothing)."""
+    step_dir = os.path.join(os.path.abspath(directory), str(step))
+    candidates: list[tuple[int, str]] = []
+    for root, _, names in os.walk(step_dir):
+        for name in names:
+            p = os.path.join(root, name)
+            size = os.path.getsize(p)
+            if size > 0:
+                candidates.append((size, p))
+    if not candidates:
+        raise FileNotFoundError(
+            f"no non-empty files under {step_dir} to truncate — checkpoint "
+            "layout changed or the step is not durable yet")
+    size, path = max(candidates)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(1, size // 3))
+    return [path]

@@ -1,0 +1,44 @@
+"""NaN/inf loss sentinel: divergence detection with a rollback contract.
+
+A diverged run (NaN loss from an LR spike, a bad batch, bf16 overflow) is
+worse than a crashed one: it keeps training, keeps CHECKPOINTING the poisoned
+state, and the failure surfaces epochs later as garbage scores. The sentinel
+checks the host-side epoch loss the moment it is aggregated — BEFORE the epoch
+checkpoint save, so a diverged state is never made durable — and raises
+``DivergenceError``. Recovery treats that differently from a crash: roll back
+to the last good checkpoint and retry with a reduced LR, under its own budget
+(``resilience.nan_retry_budget`` / ``nan_lr_factor``), because replaying the
+exact same trajectory would diverge identically.
+
+Host-side by design: the check reads the loss scalar the epoch summary already
+fetched, so it costs nothing on the device and adds no sync point.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+class DivergenceError(RuntimeError):
+    """Training loss went NaN/inf. Carries where, so the recovery event and
+    the rollback target are exact."""
+
+    def __init__(self, value: float, epoch: int, tag: str):
+        self.value = value
+        self.epoch = epoch
+        self.tag = tag
+        super().__init__(
+            f"{tag}: non-finite train loss ({value!r}) at epoch {epoch} — "
+            "divergence; rolling back to the last good checkpoint with a "
+            "reduced LR is the recovery path (resilience.nan_retry_budget)")
+
+
+class LossSentinel:
+    """Per-epoch finiteness gate over the aggregated train loss."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+
+    def check(self, value: float, *, epoch: int, tag: str) -> None:
+        if self.enabled and not math.isfinite(value):
+            raise DivergenceError(float(value), epoch, tag)

@@ -1,0 +1,196 @@
+"""Heartbeat watchdog + bounded backend-init probe.
+
+The failure class this exists for is the SILENT HANG: BENCH_r04/r05 recorded
+0.0 because a fresh client's device claim wedged inside backend init — no
+exception, no timeout, nothing for ``fit_with_recovery``'s exception-based
+retry to catch. Two mechanisms convert hangs into loud, retriable failures:
+
+* ``probe_devices`` runs ``jax.devices()`` in a KILLABLE SUBPROCESS with a
+  bounded timeout and retry + exponential backoff. An in-process hang cannot be
+  timed out (the GIL holder is stuck in native code); a subprocess can always
+  be killed. The probe claims and releases the backend before the real process
+  ever initializes it, so transient claim contention (a previous holder still
+  exiting) is retried away and the hard wedge becomes a parseable error.
+
+* ``Watchdog`` guards an in-process section with a heartbeat deadline: the
+  guarded loop calls ``beat()`` on every unit of progress, and a monitor
+  thread that sees the deadline expire raises a watchdog signal whose handler
+  (installed for the guard's duration) raises ``WatchdogTimeout`` in the main
+  thread — an ordinary ``Exception`` that ``fit_with_recovery`` treats as
+  retriable, unlike the hang it replaces. A dedicated signal (SIGUSR1), not
+  ``interrupt_main``: interrupt_main simulates SIGINT, which the preemption
+  handler intercepts with a flag-setting (non-raising) handler during
+  training — the interrupted ``sleep``/wait would simply RESUME (PEP 475) and
+  the hang would survive its own watchdog.
+
+Limits, stated honestly: a raising signal handler lands at the next Python
+bytecode boundary, so a hang inside a native call that never releases the GIL
+is not interruptible in-process — that class is exactly what the SUBPROCESS
+probe exists for. Host-side stalls (data pipeline waits, device sync waits,
+lock/sleep-style blocking) are interruptible and are what the in-process
+watchdog covers.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+class WatchdogTimeout(RuntimeError):
+    """A guarded section missed its heartbeat deadline.
+
+    Subclasses ``RuntimeError`` so the restart-based recovery path retries it
+    exactly like a raised step failure."""
+
+
+class Watchdog:
+    """Heartbeat deadline over a code section, entered from the MAIN thread.
+
+    Usage::
+
+        with Watchdog(timeout_s=120, label="train_step") as wd:
+            for batch in batches:
+                wd.beat()          # progress -> push the deadline out
+                step(batch)        # a hang here raises WatchdogTimeout
+
+    The monitor thread polls at ~timeout/10 (bounded to [50 ms, 1 s]); on
+    expiry it raises the watchdog signal, whose handler — ours, for exactly
+    the guard's duration — raises ``WatchdogTimeout`` in the main thread.
+    """
+
+    #: Signal owned by the watchdog while a guard is active. SIGUSR1 is unused
+    #: elsewhere in this codebase and safely re-entrant with the preemption
+    #: handler's SIGTERM/SIGINT.
+    SIGNAL = signal.SIGUSR1
+
+    def __init__(self, timeout_s: float, label: str = "section"):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.label = label
+        self._poll_s = max(0.05, min(1.0, self.timeout_s / 10.0))
+        self._deadline = 0.0
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._saved = None
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def beat(self) -> None:
+        self._deadline = time.monotonic() + self.timeout_s
+
+    def suspend(self) -> None:
+        """Push the deadline out indefinitely for a section that may
+        legitimately block longer than any step deadline — the preemption
+        path's final synchronous checkpoint, where firing mid-save would
+        replace the clean ``Preempted`` exit with a retriable timeout on a
+        host that is being evicted. The platform's grace-window SIGKILL is
+        the backstop for that section, not this watchdog."""
+        self._deadline = float("inf")
+
+    def _timeout_error(self) -> WatchdogTimeout:
+        return WatchdogTimeout(
+            f"{self.label}: no heartbeat within {self.timeout_s:g}s "
+            "(silent hang converted to a retriable failure)")
+
+    def _on_signal(self, signum, frame):
+        raise self._timeout_error()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            if time.monotonic() > self._deadline:
+                self._fired = True
+                # pthread_kill TARGETS THE MAIN THREAD, not raise_signal:
+                # raise_signal delivers to the calling (monitor) thread, which
+                # leaves the main thread's blocking call (sleep, lock, poll)
+                # uninterrupted — the handler would only run after the hang
+                # ended by itself. Delivery to the main thread EINTRs its
+                # blocking call; the handler raises, so the call is not
+                # restarted (PEP 475 only restarts when the handler returns).
+                signal.pthread_kill(threading.main_thread().ident, self.SIGNAL)
+                return
+
+    def __enter__(self) -> "Watchdog":
+        if threading.current_thread() is not threading.main_thread():
+            # The raising handler executes in the main thread; guarding any
+            # other thread would silently protect nothing.
+            raise RuntimeError("Watchdog must be entered from the main thread")
+        self._saved = signal.signal(self.SIGNAL, self._on_signal)
+        self.beat()
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name=f"watchdog:{self.label}")
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+        handled = exc_type is not None and issubclass(exc_type, WatchdogTimeout)
+        if self._fired and not handled:
+            # Fired, but the raise has not surfaced in the main thread yet
+            # (the guarded block completed, or another exception is already
+            # propagating). Drain it while OUR handler is still installed —
+            # restoring first could hand a pending SIGUSR1 to SIG_DFL, which
+            # kills the process.
+            deadline = time.monotonic() + 10 * self._poll_s
+            try:
+                while time.monotonic() < deadline:
+                    time.sleep(self._poll_s / 10)
+            except WatchdogTimeout:
+                pass
+        signal.signal(self.SIGNAL, self._saved)
+        if self._fired and exc_type is None:
+            raise self._timeout_error() from None
+        return False
+
+
+PROBE_SNIPPET = (
+    "import jax, json; ds = jax.devices(); "
+    "print(json.dumps({'n': len(ds), 'platform': ds[0].platform}))"
+)
+
+
+def probe_devices(attempts: int = 3, timeout_s: float = 150.0,
+                  backoff_s: float = 20.0, on_retry=None) -> dict:
+    """Check that ``jax.devices()`` completes in a bounded subprocess.
+
+    Returns the probe info dict (``{"n", "platform"}``) on success, or a
+    failure-description dict with an ``"error"`` key after ``attempts`` tries.
+    Retries back off exponentially (``backoff_s``, ``2*backoff_s``, ...) —
+    transient claim contention (a previous holder still exiting) resolves in
+    seconds; the hard wedge does not resolve at all, which is exactly what the
+    bounded timeout converts into a parseable failure instead of a hang.
+    ``on_retry(attempt, error)`` is called before each back-off sleep.
+    """
+    last_err = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            if on_retry is not None:
+                on_retry(attempt, last_err)
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            last_err = (f"backend probe hung >{timeout_s:.0f}s "
+                        "(device-claim wedge)")
+            continue
+        if proc.returncode == 0:
+            try:
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                last_err = f"probe emitted unparseable output: {proc.stdout[-200:]}"
+                continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        last_err = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
+    return {"error": f"backend init failed after {attempts} attempts: {last_err}"}

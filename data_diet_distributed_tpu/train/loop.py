@@ -17,6 +17,7 @@ metrics globally (§2.4.5), and checkpoints on an interval (§2.4.9).
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import time
 from dataclasses import dataclass, field
@@ -36,6 +37,10 @@ from ..obs import MetricsLogger
 from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
 from ..pruning import select_indices
+from ..resilience import inject
+from ..resilience.preemption import Preempted, PreemptionHandler
+from ..resilience.sentinel import DivergenceError, LossSentinel
+from ..resilience.watchdog import Watchdog, WatchdogTimeout
 from .state import TrainState, create_train_state
 from .steps import make_eval_step, make_train_step
 
@@ -151,12 +156,24 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                                      max_to_keep=cfg.train.keep_checkpoints)
             if cfg.train.resume and (resume_step is not None
                                      or ckpt.latest_step() is not None):
-                state = ckpt.restore(state, resume_step)
+                if cfg.resilience.verify_restore:
+                    # Manifest-verified restore: a truncated/drifted latest
+                    # checkpoint falls back to the newest earlier durable step
+                    # (each rejection logged) instead of crashing in Orbax
+                    # deserialization mid-resume.
+                    state, used_step = ckpt.restore_verified(
+                        state, resume_step,
+                        on_fallback=lambda **kw: logger.fault(
+                            "checkpoint_corrupt", tag=tag, **kw))
+                else:
+                    state = ckpt.restore(state, resume_step)
+                    used_step = (resume_step if resume_step is not None
+                                 else ckpt.latest_step())
                 # The epoch comes from checkpoint metadata, NOT
                 # step//steps_per_epoch: the saving run may have used a
                 # different batch size (different steps_per_epoch), which
                 # would silently land on the wrong epoch.
-                meta = ckpt.metrics(resume_step)
+                meta = ckpt.metrics(used_step)
                 if meta is not None and "epoch" in meta:
                     start_epoch = int(meta["epoch"]) + 1
                     saved_spe = meta.get("steps_per_epoch")
@@ -201,10 +218,22 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                 sharder.global_batch_size_for(cfg.data.eval_batch_size),
                 _image_dtype(cfg), enabled=cfg.train.device_resident_data)
 
-        _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
-                    sharder, logger, ckpt, start_epoch, batch_size, tag, result,
-                    saved_steps, train_resident, test_resident, steps_per_epoch,
-                    epoch_hook)
+        # Resilience envelope (resilience/): SIGTERM/SIGINT flip a polled flag
+        # (final synchronous checkpoint + Preempted), a missed per-step
+        # heartbeat raises a retriable WatchdogTimeout instead of hanging, and
+        # a NaN/inf epoch loss raises DivergenceError before the diverged
+        # state is ever checkpointed.
+        watchdog = (Watchdog(cfg.resilience.step_timeout_s,
+                             label=f"{tag} step loop")
+                    if cfg.resilience.step_timeout_s else None)
+        preempt = PreemptionHandler(enabled=cfg.resilience.preemption)
+        sentinel = LossSentinel(enabled=cfg.resilience.nan_check)
+        with preempt, (watchdog or contextlib.nullcontext()):
+            _fit_epochs(cfg, train_ds, test_ds, model, state, train_step,
+                        eval_step, sharder, logger, ckpt, start_epoch,
+                        batch_size, tag, result, saved_steps, train_resident,
+                        test_resident, steps_per_epoch, epoch_hook,
+                        watchdog=watchdog, preempt=preempt, sentinel=sentinel)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -212,10 +241,43 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     return result
 
 
+def _preempt_exit(preempt, ckpt, state, logger, tag, epoch, steps_per_epoch,
+                  saved_steps, already_durable=None, watchdog=None):
+    """Honor a preemption signal: final SYNCHRONOUS checkpoint (unless one was
+    just saved at this exact step), structured ``preempted`` event, and a
+    ``Preempted`` raise that recovery deliberately does not retry.
+
+    ``epoch`` is the last COMPLETED epoch (mid-epoch callers pass ``epoch-1``):
+    resume re-runs the interrupted epoch from its start — at-least-once epoch
+    semantics, which a mid-epoch save makes cheap but not bit-exact (the step
+    counter is mid-epoch, so the step-indexed LR schedule shifts by the replay;
+    the ``preempted`` metadata flag records that provenance)."""
+    if watchdog is not None:
+        # The final save may block past any step deadline; a WatchdogTimeout
+        # here would masquerade as a retriable hang on an evicted host.
+        watchdog.suspend()
+    step = int(state.step)
+    durable = already_durable
+    if ckpt is not None:
+        if durable is None:
+            ckpt.save(step, state, metrics={"epoch": epoch,
+                                            "steps_per_epoch": steps_per_epoch,
+                                            "preempted": True})
+            if saved_steps is not None:
+                saved_steps.append(step)
+            durable = step
+        ckpt.all_steps()   # durability barrier: the async save must land
+    logger.log("preempted", tag=tag, signal=preempt.signame, step=step,
+               epoch=epoch, durable_step=durable)
+    raise Preempted(preempt.signame, step=step, epoch=epoch,
+                    durable_step=durable)
+
+
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
                 saved_steps=None, train_resident=None, test_resident=None,
-                steps_per_epoch=None, epoch_hook=None):
+                steps_per_epoch=None, epoch_hook=None, watchdog=None,
+                preempt=None, sentinel=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         shuffle = cfg.data.shuffle_each_epoch
@@ -230,6 +292,10 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         # float() syncs would serialize the epoch on transport latency.
         step_metrics: list[dict] = []
         for i, batch in enumerate(batches):
+            if watchdog is not None:
+                watchdog.beat()
+            inject.fire("step", epoch=epoch,
+                        step=epoch * steps_per_epoch + i)
             state, metrics = train_step(state, batch)
             step_metrics.append(metrics)
             # Streaming mode: bound dispatch runahead so queued host-uploaded
@@ -241,7 +307,13 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
             if (i + 1) % cfg.train.log_every_steps == 0:
                 logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
                            loss=float(metrics["loss"]))
+            if preempt is not None and preempt.requested:
+                result.state = state
+                _preempt_exit(preempt, ckpt, state, logger, tag, epoch - 1,
+                              steps_per_epoch, saved_steps, watchdog=watchdog)
         step_metrics = jax.device_get(step_metrics)
+        if watchdog is not None:
+            watchdog.beat()   # the epoch fetch/eval/checkpoint are progress too
         epoch_s = time.perf_counter() - epoch_t0
         examples = sum(float(m["examples"]) for m in step_metrics)
         record: dict[str, Any] = {
@@ -252,18 +324,38 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
             "train_accuracy": (sum(float(m["correct"]) for m in step_metrics)
                                / max(examples, 1.0)),
         }
+        record["train_loss"] = inject.transform("epoch_loss",
+                                                record["train_loss"],
+                                                epoch=epoch)
+        if sentinel is not None:
+            try:
+                sentinel.check(record["train_loss"], epoch=epoch, tag=tag)
+            except DivergenceError:
+                # Detected BEFORE eval/checkpoint: the diverged state is never
+                # made durable, so rollback always lands on a pre-divergence
+                # checkpoint. (loss stringified: NaN is not valid JSON.)
+                logger.fault("divergence", tag=tag, epoch=epoch,
+                             step=int(state.step),
+                             loss=str(record["train_loss"]))
+                raise
         if test_ds is not None and ((epoch + 1) % cfg.train.eval_every == 0
                                     or epoch + 1 == cfg.train.num_epochs):
             ev = evaluate(model, state, test_ds, sharder, cfg.data.eval_batch_size,
                           eval_step, resident=test_resident)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
+            if watchdog is not None:
+                watchdog.beat()   # eval is its own progress unit/deadline
         if epoch_hook is not None:
             epoch_hook(model, state, epoch)
+            if watchdog is not None:
+                watchdog.beat()
         logger.log("epoch", tag=tag, **record)
         result.history.append(record)
-        if ckpt is not None and ((epoch + 1) % cfg.train.checkpoint_every == 0
-                                 or epoch + 1 == cfg.train.num_epochs):
+        save_now = ckpt is not None and (
+            (epoch + 1) % cfg.train.checkpoint_every == 0
+            or epoch + 1 == cfg.train.num_epochs)
+        if save_now:
             ckpt.save(int(state.step), state, metrics={
                 "epoch": epoch,
                 # fit's value, not recomputed: the resume-time mismatch check
@@ -273,7 +365,17 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                    if isinstance(v, (int, float))}})
             if saved_steps is not None:
                 saved_steps.append(int(state.step))
+            inject.fire("checkpoint_saved", step=int(state.step),
+                        directory=ckpt.directory, manager=ckpt)
+            if watchdog is not None:
+                watchdog.beat()   # save dispatch (and any barrier it waited on)
         result.state = state
+        inject.fire("epoch_end", epoch=epoch)
+        if preempt is not None and preempt.requested:
+            _preempt_exit(preempt, ckpt, state, logger, tag, epoch,
+                          steps_per_epoch, saved_steps,
+                          already_durable=int(state.step) if save_now else None,
+                          watchdog=watchdog)
 
 
 def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
@@ -292,48 +394,86 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
     success without training. A stale checkpoint whose step number collides with one
     of this run's is overwritten at save time (``CheckpointManager.save``), so the
     resumed payload is always this run's own.
+
+    Beyond raised step failures (which now include the watchdog's
+    ``WatchdogTimeout`` — a hang converted to an exception), two failure
+    classes get their own handling: ``Preempted`` is a CLEAN exit (final
+    checkpoint durable, process being evicted — re-entering training would
+    just be killed harder) and propagates un-retried; ``DivergenceError``
+    (NaN/inf loss) rolls back to the last good checkpoint and retries with
+    ``optim.lr *= resilience.nan_lr_factor`` under its own
+    ``resilience.nan_retry_budget`` — replaying the same LR would diverge
+    identically, so divergence retries are not generic crash retries.
     """
     logger = logger or MetricsLogger(None, echo=False)
     attempt = 0
+    nan_attempts = 0
     cfg_try = cfg
     resume_step = None
     saved_steps: list[int] = []
+
+    def _refuse_if_multihost(err, attempt_no):
+        if jax.process_count() > 1:
+            # In-process retry is single-host only: one process re-entering
+            # fit while its peers continue (or died) desyncs every
+            # collective. Multi-host recovery is restart-the-job +
+            # train.resume=true — the checkpoints this run wrote make that
+            # exact (SURVEY §5.3; PARITY.md 'Failure detection/recovery').
+            logger.log("recovery_refused", reason="multihost",
+                       attempt=attempt_no, error=repr(err)[:300])
+            raise err
+
+    def _latest_durable():
+        # Saves are async: a step lands in saved_steps when dispatched, but
+        # the write may be the very thing that failed. Resume only from
+        # steps that are finalized on disk (Orbax commits atomically, so
+        # all_steps() lists exactly the durable ones).
+        if not saved_steps:
+            return None
+        mngr = CheckpointManager(checkpoint_dir,
+                                 max_to_keep=cfg.train.keep_checkpoints)
+        try:
+            durable = set(mngr.all_steps()) & set(saved_steps)
+        finally:
+            mngr.close()
+        return max(durable) if durable else None
+
     while True:
         try:
             return fit(cfg_try, train_ds, test_ds, checkpoint_dir=checkpoint_dir,
                        logger=logger, resume_step=resume_step,
                        saved_steps=saved_steps, **kwargs)
+        except Preempted:
+            raise
+        except DivergenceError as err:
+            nan_attempts += 1
+            _refuse_if_multihost(err, nan_attempts)
+            if (nan_attempts > cfg.resilience.nan_retry_budget
+                    or checkpoint_dir is None):
+                raise
+            resume_step = _latest_durable()
+            # Compound across divergence retries: deepcopy cfg_try, not cfg.
+            cfg_try = copy.deepcopy(cfg_try)
+            cfg_try.optim.lr *= cfg.resilience.nan_lr_factor
+            cfg_try.train.resume = cfg.train.resume or resume_step is not None
+            logger.log("recovery", cause="divergence", attempt=nan_attempts,
+                       retries_left=cfg.resilience.nan_retry_budget - nan_attempts,
+                       resume=cfg_try.train.resume, resume_step=resume_step,
+                       lr=cfg_try.optim.lr, error=repr(err)[:300])
         except Exception as err:  # noqa: BLE001 — any step failure is recoverable
             attempt += 1
-            if jax.process_count() > 1:
-                # In-process retry is single-host only: one process re-entering
-                # fit while its peers continue (or died) desyncs every
-                # collective. Multi-host recovery is restart-the-job +
-                # train.resume=true — the checkpoints this run wrote make that
-                # exact (SURVEY §5.3; PARITY.md 'Failure detection/recovery').
-                logger.log("recovery_refused", reason="multihost",
-                           attempt=attempt, error=repr(err)[:300])
-                raise
+            _refuse_if_multihost(err, attempt)
             if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
                 raise
-            # Saves are async: a step lands in saved_steps when dispatched, but
-            # the write may be the very thing that failed. Resume only from
-            # steps that are finalized on disk (Orbax commits atomically, so
-            # all_steps() lists exactly the durable ones).
-            resume_step = None
-            if saved_steps:
-                mngr = CheckpointManager(checkpoint_dir,
-                                         max_to_keep=cfg.train.keep_checkpoints)
-                try:
-                    durable = set(mngr.all_steps()) & set(saved_steps)
-                finally:
-                    mngr.close()
-                resume_step = max(durable) if durable else None
-            logger.log("recovery", attempt=attempt,
+            logger.fault(
+                "hang" if isinstance(err, WatchdogTimeout) else "step_exception",
+                attempt=attempt, error=repr(err)[:300])
+            resume_step = _latest_durable()
+            logger.log("recovery", cause="exception", attempt=attempt,
                        retries_left=cfg.train.auto_resume_retries - attempt,
                        resume=cfg.train.resume or resume_step is not None,
                        error=repr(err)[:300])
-            cfg_try = copy.deepcopy(cfg)
+            cfg_try = copy.deepcopy(cfg_try)
             cfg_try.train.resume = cfg.train.resume or resume_step is not None
 
 

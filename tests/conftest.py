@@ -16,6 +16,9 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# NOTE: do NOT enable jax_compilation_cache_dir here — this image's jaxlib
+# SIGABRTs (hard process abort, not an exception) when deserializing cached
+# CPU executables, killing the whole suite mid-run.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

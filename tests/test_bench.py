@@ -48,6 +48,9 @@ def test_bench_emits_error_json_when_backend_unavailable():
 
 
 def test_probe_backend_retries_then_reports(monkeypatch):
+    # bench.probe_backend is now the resilience watchdog's probe_devices —
+    # patch the subprocess/sleep where they live.
+    from data_diet_distributed_tpu.resilience import watchdog as wd_mod
     bench = _load_bench()
 
     calls = []
@@ -61,8 +64,8 @@ def test_probe_backend_retries_then_reports(monkeypatch):
         calls.append(cmd)
         return FakeProc()
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(wd_mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(wd_mod.time, "sleep", lambda s: None)
     info = bench.probe_backend(attempts=3, timeout_s=1.0)
     assert len(calls) == 3
     assert "error" in info
@@ -70,6 +73,7 @@ def test_probe_backend_retries_then_reports(monkeypatch):
 
 
 def test_probe_backend_success(monkeypatch):
+    from data_diet_distributed_tpu.resilience import watchdog as wd_mod
     bench = _load_bench()
 
     class FakeProc:
@@ -77,7 +81,7 @@ def test_probe_backend_success(monkeypatch):
         stdout = '{"n": 1, "platform": "tpu"}\n'
         stderr = ""
 
-    monkeypatch.setattr(bench.subprocess, "run",
+    monkeypatch.setattr(wd_mod.subprocess, "run",
                         lambda cmd, **kw: FakeProc())
     info = bench.probe_backend(attempts=1, timeout_s=1.0)
     assert info == {"n": 1, "platform": "tpu"}

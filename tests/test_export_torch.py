@@ -56,9 +56,13 @@ def test_export_roundtrip(tmp_path, tiny_cfg, tiny_ds):
     np.testing.assert_allclose(jx_logits, th_logits, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("arch,stem", [("resnet50", "cifar"),
-                                       ("wideresnet28_10", "cifar"),
-                                       ("resnet18", "imagenet")])
+# resnet50/wideresnet roundtrips cost ~45 s/~35 s of CPU compile apiece for
+# wiring the resnet18-imagenet case also crosses (Bottleneck/WRN blocks are
+# covered by the parity zoo above) — unbounded lane only.
+@pytest.mark.parametrize("arch,stem", [
+    pytest.param("resnet50", "cifar", marks=pytest.mark.slow),
+    pytest.param("wideresnet28_10", "cifar", marks=pytest.mark.slow),
+    ("resnet18", "imagenet")])
 def test_export_roundtrip_zoo(tmp_path, arch, stem):
     """The export tool covers the whole zoo (VERDICT r4 missing #3 lifted the
     2-arch restriction): Bottleneck, WideResNet, and the imagenet stem, from a

@@ -1,0 +1,419 @@
+"""Fault injection end-to-end: every resilience recovery path exercised, none
+trusted (resilience/inject.py).
+
+Five injected fault classes, each asserted to either recover to a correct
+final result — pinned equal to an uninjected run where the recovery replays a
+deterministic trajectory — or refuse loudly with a structured fault/recovery
+event in the metrics JSONL; none may hang past its configured timeout:
+
+  step exception -> fit_with_recovery retry           (pinned)
+  hang           -> watchdog kill + retry             (pinned, bounded time)
+  SIGTERM        -> durable checkpoint + Preempted; resume completes (pinned)
+  truncated ckpt -> manifest-verified fallback to the earlier step  (pinned)
+  NaN loss       -> rollback to last good checkpoint + reduced-LR retry
+"""
+
+import json
+import math
+import signal
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from data_diet_distributed_tpu.checkpoint import CheckpointManager
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.resilience import watchdog as wd_mod
+from data_diet_distributed_tpu.resilience.integrity import (
+    CheckpointCorrupt, build_manifest, verify_restored)
+from data_diet_distributed_tpu.resilience.preemption import (
+    Preempted, PreemptionHandler)
+from data_diet_distributed_tpu.resilience.sentinel import DivergenceError
+from data_diet_distributed_tpu.resilience.watchdog import (
+    Watchdog, WatchdogTimeout)
+from data_diet_distributed_tpu.train import loop as loop_mod
+from data_diet_distributed_tpu.train.loop import fit_with_recovery
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    inject.deactivate()
+
+
+def _mk_cfg(tmp_path, *extra):
+    """tiny_cfg with per-epoch checkpoints + a metrics JSONL to assert on."""
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0", "score.batch_size=64", *extra])
+
+
+def _pin(history):
+    """The deterministic slice of an epoch record (wall times excluded)."""
+    return [{k: rec[k] for k in ("epoch", "train_loss", "train_accuracy")}
+            for rec in history]
+
+
+def _events(cfg, kind):
+    with open(cfg.obs.metrics_path) as fh:
+        return [e for e in (json.loads(line) for line in fh if line.strip())
+                if e["kind"] == kind]
+
+
+@pytest.fixture(scope="module")
+def baseline1(tmp_path_factory, mesh8, tiny_ds):
+    """Uninjected 1-epoch run (the cosine schedule horizon is num_epochs, so
+    pinning comparisons need a baseline with the SAME epoch count)."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path_factory.mktemp("base1"))
+    return _pin(loop_mod.fit(cfg, train_ds, None, mesh=mesh8,
+                             num_epochs=1).history)
+
+
+@pytest.fixture(scope="module")
+def baseline2(tmp_path_factory, mesh8, tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path_factory.mktemp("base2"), "train.num_epochs=2")
+    return _pin(loop_mod.fit(cfg, train_ds, None, mesh=mesh8,
+                             num_epochs=2).history)
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+def test_watchdog_converts_hang_to_retriable_timeout():
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeout, match="no heartbeat within"):
+        with Watchdog(timeout_s=0.3, label="unit"):
+            time.sleep(30)
+    assert time.monotonic() - t0 < 5.0
+    assert issubclass(WatchdogTimeout, RuntimeError)  # recovery retries it
+
+
+def test_watchdog_heartbeat_keeps_section_alive():
+    with Watchdog(timeout_s=0.5) as wd:
+        for _ in range(6):
+            wd.beat()
+            time.sleep(0.15)   # 0.9 s total — only survivable via beats
+    assert not wd.fired
+
+
+def test_watchdog_suspend_covers_long_blocking_section():
+    """The preemption path's final synchronous save may block past any step
+    deadline; suspend() must keep the watchdog from firing mid-save."""
+    with Watchdog(timeout_s=0.3) as wd:
+        wd.suspend()
+        time.sleep(0.8)
+    assert not wd.fired
+
+
+def test_watchdog_requires_main_thread():
+    caught = {}
+
+    def run():
+        try:
+            with Watchdog(timeout_s=1.0):
+                pass
+        except RuntimeError as err:
+            caught["err"] = err
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert "main thread" in str(caught["err"])
+
+
+def test_probe_devices_success(monkeypatch):
+    monkeypatch.setattr(wd_mod, "PROBE_SNIPPET",
+                        'print(\'{"n": 8, "platform": "cpu"}\')')
+    info = wd_mod.probe_devices(attempts=1, timeout_s=60.0, backoff_s=0.0)
+    assert info == {"n": 8, "platform": "cpu"}
+
+
+def test_probe_devices_reports_wedge_after_timeout(monkeypatch):
+    monkeypatch.setattr(wd_mod, "PROBE_SNIPPET", "import time; time.sleep(60)")
+    retries = []
+    info = wd_mod.probe_devices(attempts=2, timeout_s=1.5, backoff_s=0.05,
+                                on_retry=lambda n, err: retries.append((n, err)))
+    assert "error" in info and "2 attempts" in info["error"]
+    assert "wedge" in info["error"]
+    assert len(retries) == 1 and "wedge" in retries[0][1]
+
+
+def test_probe_devices_surfaces_crash_stderr(monkeypatch):
+    monkeypatch.setattr(
+        wd_mod, "PROBE_SNIPPET",
+        'raise SystemExit("relay refused the device claim")')
+    info = wd_mod.probe_devices(attempts=1, timeout_s=60.0, backoff_s=0.0)
+    assert "relay refused the device claim" in info["error"]
+
+
+# -------------------------------------------------------------- preemption
+
+
+def test_preemption_first_signal_sets_flag_only():
+    with PreemptionHandler() as handler:
+        assert handler.active
+        signal.raise_signal(signal.SIGTERM)   # delivered synchronously
+        assert handler.requested
+        assert handler.signame == "SIGTERM"
+    # __exit__ restored the previous disposition.
+    assert signal.getsignal(signal.SIGTERM) is not handler._handle
+
+
+def test_preemption_mixed_signals_do_not_escalate():
+    """One Ctrl-C after a scheduler's SIGTERM must not abort the in-progress
+    final checkpoint — only a REPEAT of the same signal escalates."""
+    with PreemptionHandler() as handler:
+        signal.raise_signal(signal.SIGTERM)
+        signal.raise_signal(signal.SIGINT)   # different signal: flag only
+        assert handler.requested
+
+
+def test_preemption_second_sigint_escalates_to_default():
+    """An operator mashing Ctrl-C must not be trapped behind the final save:
+    the second delivery restores + re-raises the default disposition."""
+    with pytest.raises(KeyboardInterrupt):
+        with PreemptionHandler(signals=(signal.SIGINT,)) as handler:
+            signal.raise_signal(signal.SIGINT)
+            assert handler.requested
+            signal.raise_signal(signal.SIGINT)
+
+
+# ------------------------------------------------- manifest / fault plan unit
+
+
+def test_manifest_verification_catches_drift_and_corruption():
+    payload = {"params": {"w": jnp.ones((2, 3), jnp.float32)},
+               "batch_stats": {}, "opt_state": {"m": jnp.zeros(3)}, "step": 5}
+    manifest = build_manifest(payload, 5)
+    assert manifest["params_finite"] is True
+
+    verify_restored(payload, manifest, step=5)       # clean roundtrip
+    verify_restored(payload, None, step=5)           # pre-manifest: unverified
+
+    with pytest.raises(CheckpointCorrupt, match="records step"):
+        verify_restored(payload, manifest, step=6)
+
+    drifted = dict(payload, params={"w": jnp.ones((2, 4), jnp.float32)})
+    with pytest.raises(CheckpointCorrupt, match="shape"):
+        verify_restored(drifted, manifest, step=5)
+
+    poisoned = dict(payload, params={"w": jnp.full((2, 3), jnp.nan)})
+    with pytest.raises(CheckpointCorrupt, match="non-finite"):
+        verify_restored(poisoned, manifest, step=5)
+
+
+def test_fault_plan_from_env(monkeypatch):
+    monkeypatch.setenv("DDT_FAULT_PLAN", '{"hang_at": 3, "hang_seconds": 1.5}')
+    plan = inject.activate_from_env()
+    assert plan.hang_at == 3 and plan.hang_seconds == 1.5
+    assert inject.active_plan() is plan
+    inject.deactivate()
+
+    monkeypatch.setenv("DDT_FAULT_PLAN", '{"hangat": 3}')
+    with pytest.raises(ValueError, match="hangat"):   # typo never disarms a drill
+        inject.activate_from_env()
+
+
+def test_resilience_config_block_loads_and_validates():
+    cfg = load_config(None, ["resilience.step_timeout_s=2.5",
+                             "resilience.nan_retry_budget=3",
+                             "resilience.preemption=false"])
+    assert cfg.resilience.step_timeout_s == 2.5
+    assert cfg.resilience.nan_retry_budget == 3
+    assert cfg.resilience.preemption is False
+    with pytest.raises(ValueError, match="nan_lr_factor"):
+        load_config(None, ["resilience.nan_lr_factor=0"])
+    with pytest.raises(ValueError, match="step_timeout_s"):
+        load_config(None, ["resilience.step_timeout_s=-1"])
+
+
+# ------------------------------------------------- injected faults, end to end
+
+
+def test_injected_step_exception_recovers_pinned(tmp_path, mesh8, tiny_ds,
+                                                 baseline1):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path)
+    cfg.train.auto_resume_retries = 2
+    inject.activate(inject.FaultPlan(step_exception_at=1))
+    res = fit_with_recovery(cfg, train_ds, None,
+                            checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                            logger=MetricsLogger(cfg.obs.metrics_path,
+                                                 echo=False))
+    # No checkpoint was durable at the injected step, so the retry restarts
+    # from scratch and must replay the uninjected trajectory exactly.
+    assert _pin(res.history) == baseline1
+    faults = _events(cfg, "fault")
+    assert [f["fault"] for f in faults] == ["step_exception"]
+    assert _events(cfg, "recovery")[0]["cause"] == "exception"
+
+
+def test_injected_hang_watchdog_kills_and_recovery_repins(tmp_path, mesh8,
+                                                          tiny_ds, baseline1):
+    """The BENCH_r04/r05 class: silent hang -> WatchdogTimeout -> retry,
+    bounded in wall-clock by the configured heartbeat deadline."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "resilience.step_timeout_s=8")
+    cfg.train.auto_resume_retries = 2
+    inject.activate(inject.FaultPlan(hang_at=2, hang_seconds=600.0))
+    t0 = time.monotonic()
+    res = fit_with_recovery(cfg, train_ds, None,
+                            checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                            logger=MetricsLogger(cfg.obs.metrics_path,
+                                                 echo=False))
+    assert time.monotonic() - t0 < 90.0   # vs. the 600 s injected hang
+    assert _pin(res.history) == baseline1
+    faults = _events(cfg, "fault")
+    assert [f["fault"] for f in faults] == ["hang"]
+    assert "WatchdogTimeout" in faults[0]["error"]
+
+
+def test_sigterm_at_epoch_end_preempts_then_resumes_pinned(tmp_path, mesh8,
+                                                           tiny_ds, baseline2):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.num_epochs=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    with pytest.raises(Preempted) as exc_info:
+        fit_with_recovery(cfg, train_ds, None,
+                          checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                          logger=logger)
+    # Clean preemption: epoch 0's checkpoint (step 4) was already durable.
+    assert exc_info.value.durable_step == 4
+    assert exc_info.value.epoch == 0
+    ev = _events(cfg, "preempted")
+    assert ev and ev[0]["signal"] == "SIGTERM" and ev[0]["durable_step"] == 4
+
+    # Resume exactly as the Preempted message instructs.
+    cfg.train.resume = True
+    res = fit_with_recovery(cfg, train_ds, None,
+                            checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                            logger=logger)
+    assert int(res.state.step) == 8
+    # Epoch 1 replays bitwise from the restored state: pinned to uninjected.
+    assert _pin(res.history) == baseline2[1:]
+
+
+def test_sigterm_mid_epoch_saves_final_sync_checkpoint(tmp_path, mesh8,
+                                                       tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path)
+    inject.activate(inject.FaultPlan(sigterm_at_step=2))
+    with pytest.raises(Preempted) as exc_info:
+        fit_with_recovery(cfg, train_ds, None,
+                          checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                          logger=MetricsLogger(cfg.obs.metrics_path,
+                                               echo=False))
+    # The signal landed mid-epoch (before step i=2; the in-flight step still
+    # completed) — the handler made a final SYNCHRONOUS mid-epoch save.
+    assert exc_info.value.step == 3
+    assert exc_info.value.durable_step == 3
+    mngr = CheckpointManager(f"{tmp_path}/ckpt")
+    try:
+        assert 3 in mngr.all_steps()
+        meta = mngr.metrics(3)
+    finally:
+        mngr.close()
+    # epoch -1 = "no epoch completed": resume re-runs epoch 0 (at-least-once
+    # semantics); the preempted flag records the mid-epoch provenance.
+    assert meta["preempted"] is True and meta["epoch"] == -1
+
+
+def test_truncated_checkpoint_falls_back_to_earlier_step(tmp_path, mesh8,
+                                                         tiny_ds, baseline2):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.num_epochs=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    ckdir = f"{tmp_path}/ckpt"
+    # Training run whose FINAL checkpoint (step 8) gets truncated on disk.
+    inject.activate(inject.FaultPlan(truncate_after_save_step=8))
+    loop_mod.fit(cfg, train_ds, None, checkpoint_dir=ckdir, mesh=mesh8,
+                 logger=logger)
+    inject.deactivate()
+
+    # Resume refuses the corrupt step 8, falls back to durable step 4, and
+    # re-trains epoch 1 to the same pinned result as an uninterrupted run.
+    cfg.train.resume = True
+    res = loop_mod.fit(cfg, train_ds, None, checkpoint_dir=ckdir, mesh=mesh8,
+                       logger=logger)
+    assert int(res.state.step) == 8
+    assert _pin(res.history) == baseline2[1:]
+    faults = _events(cfg, "fault")
+    assert [f["fault"] for f in faults] == ["checkpoint_corrupt"]
+    assert faults[0]["step"] == 8
+
+
+def test_all_checkpoints_corrupt_refuses_loudly(tmp_path, mesh8, tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path)
+    ckdir = f"{tmp_path}/ckpt"
+    loop_mod.fit(cfg, train_ds, None, checkpoint_dir=ckdir, mesh=mesh8)
+    inject.truncate_checkpoint(ckdir, 4)   # the only durable step
+    cfg.train.resume = True
+    with pytest.raises(CheckpointCorrupt, match="failed restore"):
+        loop_mod.fit(cfg, train_ds, None, checkpoint_dir=ckdir, mesh=mesh8,
+                     logger=MetricsLogger(cfg.obs.metrics_path, echo=False))
+    assert _events(cfg, "fault")[-1]["fault"] == "checkpoint_corrupt"
+
+
+def test_nan_loss_rolls_back_with_reduced_lr(tmp_path, mesh8, tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.num_epochs=2")
+    assert cfg.train.auto_resume_retries == 0   # divergence has its OWN budget
+    inject.activate(inject.FaultPlan(nan_loss_at_epoch=1))
+    res = fit_with_recovery(cfg, train_ds, None,
+                            checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                            logger=MetricsLogger(cfg.obs.metrics_path,
+                                                 echo=False))
+    # Rolled back to epoch 0's checkpoint and re-ran epoch 1 at half LR.
+    assert int(res.state.step) == 8
+    assert res.history[-1]["epoch"] == 1
+    assert math.isfinite(res.history[-1]["train_loss"])
+    faults = _events(cfg, "fault")
+    assert [f["fault"] for f in faults] == ["divergence"]
+    rec = _events(cfg, "recovery")[0]
+    assert rec["cause"] == "divergence"
+    assert rec["resume_step"] == 4
+    assert rec["lr"] == pytest.approx(cfg.optim.lr * cfg.resilience.nan_lr_factor)
+
+
+def test_nan_loss_budget_exhausted_refuses(tmp_path, mesh8, tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "resilience.nan_retry_budget=0")
+    inject.activate(inject.FaultPlan(nan_loss_at_epoch=0))
+    with pytest.raises(DivergenceError, match="non-finite train loss"):
+        fit_with_recovery(cfg, train_ds, None,
+                          checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                          logger=MetricsLogger(cfg.obs.metrics_path,
+                                               echo=False))
+    assert [f["fault"] for f in _events(cfg, "fault")] == ["divergence"]
+
+
+def test_divergence_retry_refused_multihost(tmp_path, tiny_ds, monkeypatch):
+    """The multi-host refusal (in-process retry would desync collectives)
+    covers the divergence path too — rollback is single-host only."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path)
+
+    def diverging_fit(*args, **kwargs):
+        raise DivergenceError(float("nan"), epoch=0, tag="train")
+
+    monkeypatch.setattr(loop_mod, "fit", diverging_fit)
+    monkeypatch.setattr(loop_mod.jax, "process_count", lambda: 2)
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    with pytest.raises(DivergenceError):
+        fit_with_recovery(cfg, train_ds, None,
+                          checkpoint_dir=f"{tmp_path}/ckpt", logger=logger)
+    refused = _events(cfg, "recovery_refused")
+    assert refused and refused[0]["reason"] == "multihost"

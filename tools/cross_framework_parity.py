@@ -19,7 +19,9 @@ Run (CPU recipe):
       --size 2048 --epochs 5 --seeds 0 1 2 --out artifacts/cross_framework_parity.npz
 
 Writes the npz artifact (per-seed scores for both frameworks + rhos + config)
-and prints one JSON summary line.
+and prints JSON lines as it goes: one ``{"partial": ...}`` line per completed
+seed/method checkpoint, then the full summary as the LAST stdout line —
+consumers must parse the last line, not the first.
 """
 
 from __future__ import annotations
@@ -114,6 +116,13 @@ def mean_pairwise_rho(score_sets: list[np.ndarray]) -> float:
                           for i, j in pairs]))
 
 
+def finite_or_none(value: float, ndigits: int = 4):
+    """Round for a JSON summary, mapping NaN/inf to None (-> ``null``): a
+    single-seed partial artifact has no pairwise rho, and the bare ``NaN``
+    token json.dumps would emit is rejected by strict JSON parsers."""
+    return round(float(value), ndigits) if np.isfinite(value) else None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=2048)
@@ -125,8 +134,15 @@ def main() -> None:
                                  "resnet101", "resnet152", "wideresnet28_10"])
     parser.add_argument("--seeds", type=int, nargs="+", default=[0, 1, 2])
     parser.add_argument("--methods", nargs="+", default=["el2n", "grand"])
-    parser.add_argument("--out", default="artifacts/cross_framework_parity.npz")
+    parser.add_argument("--out", default="artifacts/cross_framework_parity.npz",
+                        help="artifact path; '.npz' is appended if missing "
+                             "(np.savez used to do this implicitly — the "
+                             "atomic writer writes the name verbatim). The "
+                             "summary JSON is the LAST stdout line; per-seed "
+                             "partial lines precede it.")
     args = parser.parse_args()
+    if not args.out.endswith(".npz"):
+        args.out += ".npz"
 
     from data_diet_distributed_tpu.data.datasets import load_dataset
     from data_diet_distributed_tpu.utils.stats import spearman
@@ -166,9 +182,9 @@ def main() -> None:
         payload[f"rho_cross_{method}"] = np.float64(rho_cross)
         payload[f"rho_within_jax_{method}"] = np.float64(rho_within_jax)
         payload[f"rho_within_torch_{method}"] = np.float64(rho_within_torch)
-        summary[f"rho_cross_{method}"] = round(rho_cross, 4)
-        summary[f"rho_within_jax_{method}"] = round(rho_within_jax, 4)
-        summary[f"rho_within_torch_{method}"] = round(rho_within_torch, 4)
+        summary[f"rho_cross_{method}"] = finite_or_none(rho_cross)
+        summary[f"rho_within_jax_{method}"] = finite_or_none(rho_within_jax)
+        summary[f"rho_within_torch_{method}"] = finite_or_none(rho_within_torch)
         _atomic_savez(args.out, **payload)
         print(json.dumps({"partial": method, **summary}), flush=True)
 

@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from cross_framework_parity import mean_pairwise_rho  # noqa: E402
+from cross_framework_parity import finite_or_none, mean_pairwise_rho  # noqa: E402
 from data_diet_distributed_tpu.utils.stats import spearman  # noqa: E402
 
 
@@ -45,12 +45,15 @@ def main() -> None:
             if jx is None or th is None:
                 out[method] = "missing"
                 continue
-            out[f"rho_cross_{method}"] = round(
-                float(spearman(jx.mean(axis=0), th.mean(axis=0))), 4)
-            out[f"rho_within_jax_{method}"] = round(
-                mean_pairwise_rho(list(jx)), 4)
-            out[f"rho_within_torch_{method}"] = round(
-                mean_pairwise_rho(list(th)), 4)
+            # finite_or_none: a one-seed partial artifact (exactly what this
+            # tool exists for) has no pairwise rho — emit null, not the
+            # non-standard NaN token strict JSON parsers reject.
+            out[f"rho_cross_{method}"] = finite_or_none(
+                float(spearman(jx.mean(axis=0), th.mean(axis=0))))
+            out[f"rho_within_jax_{method}"] = finite_or_none(
+                mean_pairwise_rho(list(jx)))
+            out[f"rho_within_torch_{method}"] = finite_or_none(
+                mean_pairwise_rho(list(th)))
             out[f"n_jax_seeds_{method}"] = int(jx.shape[0])
             out[f"n_torch_seeds_{method}"] = int(th.shape[0])
     print(json.dumps(out))
