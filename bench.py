@@ -32,8 +32,26 @@ import numpy as np
 
 # Importable before backend init by design (see resilience/__init__.py) — the
 # probe must run while no in-process device claim exists yet.
+from data_diet_distributed_tpu.resilience.preemption import (EXIT_PREEMPTED,
+                                                             Preempted)
 from data_diet_distributed_tpu.resilience.watchdog import \
     probe_devices as probe_backend
+
+#: Exit-code classification for the BENCH json (and for supervisors reading
+#: this process's own status): 0 ok; 69 = EX_UNAVAILABLE (backend wedge /
+#: poisoned peer) — retry the run; 75 = EX_TEMPFAIL (preemption, checkpoint
+#: durable) — resubmit with resume; anything else (including death-by-signal,
+#: reported by subprocess APIs as a negative code) is fatal.
+EXIT_CLASSES = {0: "ok", 69: "retriable", 75: "preempted"}
+
+
+def classify_exit(code: int) -> str:
+    """Map a child (or own) exit code to its supervisor-facing class. A
+    driver branching on this never mistakes an interrupted run's zeroed
+    metric for a measured zero."""
+    if code < 0:
+        return f"fatal:signal{-code}"
+    return EXIT_CLASSES.get(code, "fatal")
 
 
 NORTH_STAR_EXAMPLES_PER_SEC = 8333.0   # 50k x 10 seeds / 60 s
@@ -128,24 +146,45 @@ def main() -> None:
     if not args.no_probe:
         info = probe_backend(args.probe_attempts, args.probe_timeout)
         if info is None or "error" in info:
-            emit(metric, 0.0, unit, 0.0,
+            # The probe's failing child exits are classified, not folded into
+            # a bare zero: a wedged backend is RETRIABLE (69), and the driver
+            # can branch on exit_class without parsing error strings. (rc 0:
+            # the JSON line IS the parseable result, per the bench contract.)
+            emit(metric, 0.0, unit, 0.0, exit_code=69,
+                 exit_class=classify_exit(69),
                  error=(info or {}).get("error", "backend probe failed"))
             return
 
     try:
         if args.num_processes > 1:
-            import jax
-            jax.distributed.initialize(coordinator_address=args.coordinator,
-                                       num_processes=args.num_processes,
-                                       process_id=args.process_id)
+            # The production multi-host entry (NOT raw jax.distributed): it
+            # also pins the CPU collectives implementation on jaxlib versions
+            # whose CPU client can't compile cross-process computations
+            # without one (parallel/mesh.initialize_multihost).
+            from data_diet_distributed_tpu.config import MeshConfig
+            from data_diet_distributed_tpu.parallel.mesh import \
+                initialize_multihost
+            initialize_multihost(MeshConfig(
+                multihost=True, coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id))
         if args.task == "train":
             bench_train(args, metric)
         elif args.task == "northstar":
             bench_northstar(args, metric)
         else:
             bench_score(args, metric)
+    except Preempted as exc:
+        # An interrupted bench run is NOT a measured zero: the JSON records
+        # the preemption class and the process exits 75 so a supervisor
+        # resubmits instead of recording a bogus throughput.
+        emit(metric, 0.0, unit, 0.0, exit_code=EXIT_PREEMPTED,
+             exit_class=classify_exit(EXIT_PREEMPTED),
+             error=f"preempted: {exc}"[:500])
+        raise SystemExit(EXIT_PREEMPTED)
     except Exception as exc:   # noqa: BLE001 — the driver needs a JSON line, not a trace
-        emit(metric, 0.0, unit, 0.0,
+        emit(metric, 0.0, unit, 0.0, exit_code=1,
+             exit_class=classify_exit(1),
              error=f"{type(exc).__name__}: {exc}"[:500])
         raise SystemExit(1)
 

@@ -144,6 +144,38 @@ class CheckpointManager:
             f"{candidates} failed restore/verification; last error: "
             f"{last_err!r}") from last_err
 
+    def verified_steps(self, max_step: int | None = None) -> list[int]:
+        """Durable steps whose save-time manifest loads and matches its step —
+        the cheap (metadata-only, no tensor IO) candidate set each rank
+        contributes to consensus restore (``Consensus.agree_restore_step``).
+        Pre-manifest checkpoints count, matching ``restore_verified``'s
+        restorable-unverified contract; payload-level truncation is caught
+        later by ``restore_checked`` on the one agreed step."""
+        out = []
+        for s in self.all_steps():
+            if max_step is not None and s > max_step:
+                continue
+            try:
+                m = self.manifest(s)
+            except Exception:  # noqa: BLE001 — unreadable manifest: not a candidate
+                continue
+            if m is None or int(m.get("step", s)) == int(s):
+                out.append(s)
+        return sorted(out)
+
+    def restore_checked(self, state: "TrainState", step: int) -> "TrainState":
+        """Restore EXACTLY ``step`` with manifest verification and NO
+        fallback — the consensus restore path. Falling back per-rank to an
+        earlier step (``restore_verified``) would silently desync the ranks
+        the agreed step exists to keep in lockstep; a rank that cannot
+        restore the agreed step must fail loudly instead."""
+        restored = self.restore(state, step)
+        verify_restored(
+            {"params": restored.params, "batch_stats": restored.batch_stats,
+             "opt_state": restored.opt_state, "step": restored.step},
+            self.manifest(step), step=step)
+        return restored
+
     def metrics(self, step: int | None = None) -> dict[str, Any] | None:
         """The metrics JSON saved alongside a step (None if absent) — carries
         the epoch counter, so resume does not have to derive it from

@@ -16,8 +16,6 @@ from __future__ import annotations
 import argparse
 import sys
 
-import numpy as np
-
 from .config import Config, load_config
 from .obs import MetricsLogger, ResourceMonitor, plot_metrics, plot_utilization
 
@@ -53,13 +51,14 @@ def main(argv: list[str] | None = None) -> int:
         # Skipped under multihost (same as bench.py): the probe subprocess
         # has no jax.distributed rendezvous, so it would try to claim the
         # full slice single-process and fail a healthy multi-host job.
+        from .resilience.consensus import EXIT_RETRIABLE
         from .resilience.watchdog import probe_devices
         info = probe_devices(cfg.resilience.probe_attempts,
                              cfg.resilience.probe_timeout_s,
                              cfg.resilience.probe_backoff_s)
         if "error" in info:
             print(f"[resilience] {info['error']}", file=sys.stderr, flush=True)
-            return 69   # EX_UNAVAILABLE: backend wedged before any claim
+            return EXIT_RETRIABLE   # EX_UNAVAILABLE: wedged before any claim
     from .parallel.mesh import initialize_multihost
     initialize_multihost(cfg.mesh)
 
@@ -133,18 +132,26 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
     elif command == "score":
         from .data.pipeline import BatchSharder
         from .parallel.mesh import is_primary, make_mesh
-        from .train.loop import compute_scores, load_data_for, scores_npz_path
+        from .train.loop import (compute_scores, load_data_for,
+                                 pipeline_stages, scores_npz_path)
+        from .utils.io import atomic_savez
         mesh = make_mesh(cfg.mesh)
         sharder = BatchSharder(mesh)
         train_ds, _ = load_data_for(cfg)
+        # Stage-resumable like `run`: per-seed partials under checkpoint_dir;
+        # a preempted (75) score command re-invoked with the same config
+        # recomputes only the incomplete seeds.
         scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
-                                         sharder=sharder, logger=logger)
+                                         sharder=sharder, logger=logger,
+                                         stages=pipeline_stages(cfg, logger))
         out = scores_npz_path(cfg.train.checkpoint_dir)
         if is_primary():   # every process holds the full scores; one writes
             method = (f"reused:{score_t['loaded_from']}"
                       if score_t.get("loaded_from") else cfg.score.method)
-            np.savez(out, scores=scores, indices=train_ds.indices,
-                     method=method)
+            # Atomic: a kill mid-write must never leave a truncated npz a
+            # later score.scores_npz reuse would trust.
+            atomic_savez(out, scores=scores, indices=train_ds.indices,
+                         method=method)
         logger.log("scores_saved", path=out, n=len(scores),
                    mean=float(scores.mean()), std=float(scores.std()),
                    score_s=round(score_t["score_s"], 3),

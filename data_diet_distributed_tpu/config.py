@@ -220,6 +220,29 @@ class ResilienceConfig:
     probe_attempts: int = 3
     probe_timeout_s: float = 150.0
     probe_backoff_s: float = 20.0
+    # Multi-host fault consensus (resilience/consensus.py; no-op
+    # single-process): preemption flags OR-reduced so every rank writes the
+    # same final checkpoint and exits 75 together; the NaN verdict globally
+    # agreed; restore pinned to the newest step EVERY rank verified; watchdog
+    # firings broadcast through a poison side-channel so peers abort instead
+    # of hanging in a dead collective.
+    consensus: bool = True
+    # Preemption/peer-poison poll cadence in steps: 1 = every step (tightest
+    # agreement, one tiny allgather per step); raise it to amortize on
+    # meshes where per-step host collectives measurably cost.
+    consensus_poll_steps: int = 1
+    # After a watchdog firing (own or peer-poisoned), a rank whose main
+    # thread is still wedged in a collective this much later exits with the
+    # retriable status 69 — bounded abort instead of unbounded hang.
+    consensus_grace_s: float = 15.0
+    # Poison side-channel directory; None -> <train.checkpoint_dir>_sidechannel
+    # (must be on a filesystem every rank sees, like the checkpoint dir).
+    sidechannel_dir: str | None = None
+    # Durable stage manifest + per-seed score partials (resilience/stages.py):
+    # an interrupted run/sweep re-enters at the exact pipeline stage — scores
+    # resume from the first incomplete seed, a mid-retrain preemption resumes
+    # from the retrain's own checkpoints, completed sweep levels are skipped.
+    stage_resume: bool = True
 
 
 @dataclass
@@ -311,6 +334,14 @@ class Config:
                 "resilience probe settings need probe_attempts >= 1, "
                 "probe_timeout_s > 0, probe_backoff_s >= 0; got "
                 f"{r.probe_attempts}/{r.probe_timeout_s}/{r.probe_backoff_s}")
+        if r.consensus_poll_steps < 1:
+            raise ValueError(
+                f"resilience.consensus_poll_steps must be >= 1, got "
+                f"{r.consensus_poll_steps}")
+        if r.consensus_grace_s <= 0:
+            raise ValueError(
+                f"resilience.consensus_grace_s must be > 0, got "
+                f"{r.consensus_grace_s}")
         return self
 
 

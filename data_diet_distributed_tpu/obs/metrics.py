@@ -42,6 +42,21 @@ class MetricsLogger:
         ``recovery_refused`` / ``preempted`` events share the JSONL stream."""
         self.log("fault", fault=fault, **fields)
 
+    def stage(self, stage: str, status: str, **fields: Any) -> None:
+        """Structured pipeline-stage event: ``{"kind": "stage", "stage": ...,
+        "status": "started"|"done"|"skipped"|"reset"|"invalid", ...}`` — the
+        durable stage manifest's (``resilience/stages.py``) JSONL mirror, so
+        resume tooling can replay what was skipped vs recomputed."""
+        self.log("stage", stage=stage, status=status, **fields)
+
+    def consensus(self, event: str, **fields: Any) -> None:
+        """Structured multi-host consensus event: ``{"kind": "consensus",
+        "event": "preempt_agreed"|"restore_agreed"|"poison"|"peer_poisoned",
+        ...}`` (``resilience/consensus.py``). Process-0 gated like every
+        event — a non-primary rank's poison still lands in the side-channel
+        and in its peers' ``peer_poisoned`` events."""
+        self.log("consensus", event=event, **fields)
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()

@@ -47,6 +47,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax <= 0.4.x names it TPUCompilerParams; same constructor surface for
+    # the fields used here (vmem_limit_bytes, has_side_effects).
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 TILE_B = 256  # batch rows per grid step; multiple of the fp32 sublane tile (8)
 
 

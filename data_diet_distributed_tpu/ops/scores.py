@@ -34,6 +34,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .pallas_kernels import el2n_pallas, grand_last_layer_pallas
 
+# shard_map moved to the jax top level (with check_vma) after 0.4.x, where it
+# lives under jax.experimental (with check_rep). Bind one callable with
+# replication/VMA checking OFF either way: jax.grad taken INSIDE the body
+# w.r.t. replicated (P()) params would otherwise auto-insert a psum over
+# 'data' — summing per-example gradients across devices (see _wrap).
+if hasattr(jax, "shard_map"):
+    _shard_map = partial(jax.shard_map, check_vma=False)
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+    _shard_map = partial(_experimental_shard_map, check_rep=False)
+
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Per-example CE loss, [B] <- logits [B, C], labels [B]."""
@@ -109,10 +120,10 @@ def _wrap(local_scores, mesh: Mesh | None):
 
     from ..parallel.mesh import flat_batch_spec
     spec = flat_batch_spec(mesh)
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_scores, mesh=mesh,
         in_specs=(P(), spec, spec, spec),
-        out_specs=spec, check_vma=False)
+        out_specs=spec)
 
     @jax.jit
     def step(variables, batch):

@@ -43,7 +43,8 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   method: str = "el2n", batch_size: int = 512,
                   sharder: BatchSharder | None = None, chunk: int = 32,
                   eval_mode: bool = True, use_pallas: bool | None = None,
-                  score_step=None, device_resident: bool | None = None) -> np.ndarray:
+                  score_step=None, device_resident: bool | None = None,
+                  on_seed_done=None) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 
     ``variables_seeds`` is a sequence of model variable pytrees (one per scoring seed);
@@ -51,6 +52,14 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     (None = auto by dataset size) uploads the batches once and reuses them for
     every seed — multi-seed scoring then pays host→device transfer once, not
     ``n_seeds`` times.
+
+    ``on_seed_done(k, seed_scores)`` fires after each seed's full pass with
+    that seed's float64 score vector (every process holds it, multi-host
+    included) — the stage-resume attachment point: ``compute_scores``
+    persists per-seed partials there, so an interrupted multi-seed scoring
+    run loses at most the in-flight seed's pass. The hook may raise (e.g.
+    ``Preempted`` at a seed boundary); completed seeds' hooks have already
+    run.
     """
     mesh = sharder.mesh if sharder is not None else None
     if sharder is not None and len(sharder.axes) < len(mesh.axis_names):
@@ -105,13 +114,16 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     # every uploaded batch live — an OOM for >HBM datasets, the exact case
     # streaming exists for). Resident mode holds the dataset anyway: one flush.
     window = len(resident) if resident is not None else 8
-    for variables in variables_seeds:
+    for k, variables in enumerate(variables_seeds):
+        # Per-seed accumulator (not straight into ``total``): the completed
+        # seed's vector is what on_seed_done persists for stage resume.
+        seed_scores = np.zeros(n, np.float64)
         pending: list[tuple[np.ndarray, np.ndarray, jax.Array]] = []
 
         def flush():
             for (idx, mask, _), scores in zip(
                     pending, _to_host([p[2] for p in pending])):
-                total[pos_of(idx[mask])] += scores[mask]
+                seed_scores[pos_of(idx[mask])] += scores[mask]
             pending.clear()
 
         for idx, mask, batch in (resident if resident is not None
@@ -120,4 +132,7 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
             if len(pending) >= window:
                 flush()
         flush()
+        total += seed_scores
+        if on_seed_done is not None:
+            on_seed_done(k, seed_scores)
     return (total / len(variables_seeds)).astype(np.float32)

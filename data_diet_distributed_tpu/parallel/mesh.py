@@ -32,6 +32,18 @@ MODEL_AXIS = "model"
 def initialize_multihost(cfg: MeshConfig) -> None:
     """Join the multi-host runtime. No-op unless configured (single-host default)."""
     if cfg.multihost:
+        if "cpu" in (getattr(jax.config, "jax_platforms", None) or ""):
+            # Multi-process CPU (the 2-process test harness, CPU staging
+            # runs): jaxlib's CPU client compiles cross-process computations
+            # only with a collectives implementation selected; some versions
+            # default to none and fail with "Multiprocess computations aren't
+            # implemented on the CPU backend". Pin gloo BEFORE initialize;
+            # versions that dropped/renamed the option handle it themselves.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:   # noqa: BLE001 — newer jax: auto-selected
+                pass
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
@@ -69,9 +81,22 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def _device_put(tree, sharding) -> "jax.Array":
+    """``jax.device_put`` that also works on jaxlib versions whose
+    ``device_put`` rejects COMMITTED arrays under a non-fully-addressable
+    (multi-process) sharding: decommit through numpy first — those versions
+    accept host arrays there (with a cross-process equality check), and the
+    placement-time host copy is paid once per fit, not per step."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(tree, sharding)
+    # Every leaf, python scalars included (a fresh state's step=0): those
+    # versions only accept numpy-like inputs under non-addressable shardings.
+    return jax.device_put(jax.tree.map(np.asarray, tree), sharding)
+
+
 def replicate(tree, mesh: Mesh):
     """Place a pytree fully replicated on the mesh (params, opt state)."""
-    return jax.device_put(tree, replicated(mesh))
+    return _device_put(tree, replicated(mesh))
 
 
 def param_specs(params, mesh: Mesh):
@@ -135,7 +160,7 @@ def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
 
     def put(tree, spec_tree):
         return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree)
+            lambda x, s: _device_put(x, NamedSharding(mesh, s)), tree, spec_tree)
 
     def opt_spec(path, leaf):
         # Optimizer slots mirror the param tree somewhere under their own
@@ -158,7 +183,7 @@ def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
     params = put(state.params, specs)
     opt_state = put(state.opt_state, jax.tree_util.tree_map_with_path(
         opt_spec, state.opt_state))
-    rest = jax.device_put(
+    rest = _device_put(
         {"batch_stats": state.batch_stats, "step": state.step}, replicated(mesh))
     return state.replace(params=params, opt_state=opt_state,
                          batch_stats=rest["batch_stats"], step=rest["step"])
@@ -168,3 +193,13 @@ def is_primary() -> bool:
     """Process-0 gating for checkpoint/metrics IO (reference: ``if rank == 0``,
     ``ddp.py:105,114,157``)."""
     return jax.process_index() == 0
+
+
+def sync_hosts(name: str) -> None:
+    """Cross-host barrier, no-op single-process — ONE definition so callers
+    (consensus side-channel open, test harnesses) never hand-roll
+    ``multihost_utils`` imports. ``name`` must be reached by every process in
+    the same order; it keys the barrier."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

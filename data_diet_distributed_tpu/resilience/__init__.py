@@ -9,22 +9,31 @@ ended runs silently or fatally. Five mechanisms close those holes:
 ==================  =========================================================
 watchdog.py         heartbeat deadline over training steps (hang ->
                     retriable ``WatchdogTimeout``) + subprocess-bounded
-                    backend-init probe with retry/backoff (the bench wedge)
+                    backend-init probe with retry/backoff (the bench wedge);
+                    under consensus also the poison-side-channel agent
+                    (broadcast on fire, peer polling, bounded retriable
+                    escalation out of a wedged collective)
 preemption.py       SIGTERM/SIGINT -> final synchronous checkpoint ->
                     ``Preempted`` / exit 75 (resume with train.resume=true)
 integrity.py        save-time pytree manifest, verified at restore;
                     corruption falls back to the newest earlier durable step
 sentinel.py         NaN/inf epoch-loss detection BEFORE the state is
                     checkpointed; recovery rolls back with reduced LR
-inject.py           deterministic fault injection for all of the above, so
-                    every recovery path is tested, not trusted
+                    (verdict globally agreed under consensus)
+consensus.py        multi-host agreement: OR-reduced preemption, agreed
+                    divergence, min-agreed restore step, poison side-channel
+inject.py           deterministic fault injection for all of the above —
+                    rank-targetable (``rank=1``) so multi-host consensus
+                    paths are tested, not trusted
+stages.py           durable stage manifest + per-seed score partials: the
+                    run/sweep pipeline re-enters at the exact stage
 ==================  =========================================================
 
 Configured by the ``resilience:`` config block; events land in the metrics
-JSONL as structured ``fault`` / ``recovery`` / ``preempted`` /
-``checkpoint_fallback`` records. ``integrity`` is imported lazily by its users
-(it needs jax; everything here is importable before backend init — the probe
-depends on that).
+JSONL as structured ``fault`` / ``recovery`` / ``preempted`` / ``stage`` /
+``consensus`` records. ``integrity``, ``consensus``, and ``stages`` are
+imported lazily by their users (they need jax; everything here is importable
+before backend init — the probe depends on that).
 """
 
 from . import inject  # noqa: F401

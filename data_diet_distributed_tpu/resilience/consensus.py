@@ -1,0 +1,276 @@
+"""Multi-host fault consensus: every rank fails (and recovers) in lockstep.
+
+PR 1's resilience layer is strictly single-process. Under ``jax.distributed``
+each of its mechanisms becomes a DESYNC hazard: a SIGTERM delivered to one
+rank makes that rank save-and-exit while its peers block forever in the next
+collective; a rank-local NaN verdict rolls one rank back while the others
+train on; a restore where each rank trusts its own latest durable checkpoint
+resumes different steps on different ranks (one rank's final async save may
+not have landed before the fault); and a hang on one rank leaves every peer
+wedged in a collective that will never complete. Four agreement primitives
+close those holes:
+
+* **OR-reduced preemption** — the training loop's per-step preemption poll
+  goes through ``Consensus.agree_preempt``: local flags are allgathered, so
+  every rank sees the preemption on the same step, writes the SAME final
+  checkpoint step, and exits 75 together.
+* **Agreed divergence** — the NaN sentinel's finiteness verdict is globally
+  OR-reduced (``Consensus.agree``): if ANY rank sees a non-finite loss, every
+  rank raises ``DivergenceError`` at the same epoch boundary, so
+  rollback/LR-retry (a job-level restart under multi-host) happens in
+  lockstep.
+* **Min-agreed restore** — each rank's manifest-verified durable steps are
+  allgathered and intersected (``agree_common``); restore uses the NEWEST
+  step EVERY rank can verify, instead of each rank trusting its local
+  latest (``CheckpointManager.restore_checked`` — exact step, no per-rank
+  fallback).
+* **Poison side-channel** — collectives cannot carry a fault signal out of a
+  hung rank (the hung rank is exactly the one not participating). A bounded
+  filesystem side-channel under the checkpoint directory does: a firing
+  watchdog writes a poison record, peers poll it between steps (and from
+  their own watchdog's monitor thread) and abort with ``PeerPoisoned``
+  BEFORE entering the collective that would never complete; a peer already
+  wedged inside one is exited with ``EXIT_RETRIABLE`` after a bounded grace
+  (``Watchdog`` escalation) — restart-and-resume territory, not a hang.
+
+Everything degrades to a no-op single-process: ``Consensus.create`` returns
+``None`` when ``jax.process_count() == 1`` (or ``resilience.consensus`` is
+off), and the module-level helpers short-circuit. The side-channel assumes
+the checkpoint directory's filesystem is visible to every rank — the same
+assumption the shared Orbax checkpoint directory already makes.
+
+Imported lazily by its users (it needs jax); ``resilience/__init__`` stays
+importable before backend init for the probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+#: Exit status for a retriable infrastructure failure (BSD EX_UNAVAILABLE):
+#: backend wedge, poisoned peer, escalation out of a stuck collective —
+#: restart the job and resume. Distinct from EXIT_PREEMPTED (75).
+EXIT_RETRIABLE = 69
+
+#: Allgather payload width for step/seed agreement: candidate sets are capped
+#: at the newest this-many entries (far above keep_checkpoints defaults).
+MAX_AGREE_ITEMS = 64
+
+
+class PeerPoisoned(RuntimeError):
+    """A peer rank broadcast a poison value through the side-channel. Abort
+    before the next collective instead of hanging in it; subclasses
+    ``RuntimeError`` so single-host-style recovery would treat it as
+    retriable (multi-host recovery is restart-the-job + resume)."""
+
+
+def _allgather(arr: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def agree_any(flag: bool) -> bool:
+    """OR-reduce a host-side boolean across ranks (identity single-process).
+    Collective: every rank must call at the same point."""
+    import jax
+    if jax.process_count() <= 1:
+        return bool(flag)
+    return bool(_allgather(np.asarray([flag], np.int8)).any())
+
+
+def agree_common(values, max_items: int = MAX_AGREE_ITEMS) -> set[int]:
+    """The set of non-negative ints EVERY rank holds (identity single-process):
+    each rank's newest ``max_items`` values are allgathered (padded with -1 to
+    a fixed width) and intersected. Collective when multi-process."""
+    local = sorted({int(v) for v in values if int(v) >= 0})[-max_items:]
+    import jax
+    if jax.process_count() <= 1:
+        return set(local)
+    arr = np.full(max_items, -1, np.int64)
+    arr[: len(local)] = local
+    rows = _allgather(arr).reshape(jax.process_count(), max_items)
+    return set.intersection(*(set(int(v) for v in row if v >= 0)
+                              for row in rows))
+
+
+def broadcast_json(obj):
+    """Broadcast a JSON-serializable object from rank 0 to every rank
+    (identity single-process) — the one source of truth for host-side
+    decisions derived from files only rank 0 is guaranteed to see (the stage
+    manifest). Two collectives: payload length, then padded payload bytes."""
+    import jax
+    if jax.process_count() <= 1:
+        return obj
+    from jax.experimental import multihost_utils
+    payload = np.frombuffer(json.dumps(obj).encode(), np.uint8)
+    n = int(np.asarray(multihost_utils.broadcast_one_to_all(
+        np.asarray([payload.size], np.int64)))[0])
+    buf = np.zeros(n, np.uint8)
+    if jax.process_index() == 0:
+        buf[:] = payload
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return json.loads(out.tobytes().decode())
+
+
+class SideChannel:
+    """Bounded filesystem side-channel: one ``poison.rank<k>.json`` per rank
+    under a shared directory. Writes are atomic (temp + rename); reads are a
+    directory listing — cheap enough to poll from the step loop and the
+    watchdog's monitor thread."""
+
+    def __init__(self, directory: str, rank: int):
+        self.directory = os.path.abspath(directory)
+        self.rank = rank
+        self._own = os.path.join(self.directory, f"poison.rank{rank}.json")
+
+    def open(self) -> None:
+        """Create the channel dir and clear THIS rank's stale poison (each
+        rank clears its own; the caller barriers before first use so no rank
+        can read a peer's stale poison from a previous attempt)."""
+        os.makedirs(self.directory, exist_ok=True)
+        try:
+            os.remove(self._own)
+        except FileNotFoundError:
+            pass
+
+    def poison(self, reason: str) -> None:
+        tmp = f"{self._own}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"rank": self.rank, "reason": str(reason)[:500],
+                       "ts": round(time.time(), 3)}, fh)
+        os.replace(tmp, self._own)
+
+    def peer_poison(self) -> dict | None:
+        """The first peer poison record, or None. Unreadable poison files
+        (mid-write crash) still count as poison — the peer was dying."""
+        try:
+            names = sorted(os.listdir(self.directory))
+        except FileNotFoundError:
+            return None
+        own = os.path.basename(self._own)
+        for name in names:
+            if (name.startswith("poison.rank") and name.endswith(".json")
+                    and name != own):
+                try:
+                    with open(os.path.join(self.directory, name)) as fh:
+                        return json.load(fh)
+                except (OSError, ValueError):
+                    return {"rank": -1,
+                            "reason": f"unreadable poison file {name}"}
+        return None
+
+
+class Consensus:
+    """Per-fit agreement state: the side-channel plus the OR-reduce latch.
+
+    Construct via ``create`` (returns None single-process / disabled). All
+    ``agree*`` methods are collectives — every rank must reach them at the
+    same point, which the training loop guarantees by polling on the same
+    step indices everywhere.
+    """
+
+    def __init__(self, channel_dir: str, *, poll_every: int = 1,
+                 grace_s: float = 15.0, logger=None, tag: str = ""):
+        import jax
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+        self.poll_every = max(1, int(poll_every))
+        self.grace_s = float(grace_s)
+        self.logger = logger
+        self.tag = tag
+        self.channel = SideChannel(channel_dir, self.rank)
+        self._preempt_latch = False
+        self.channel.open()
+        from ..parallel.mesh import sync_hosts
+        sync_hosts(f"consensus-open:{tag}")
+
+    @classmethod
+    def create(cls, cfg, *, logger=None, tag: str = "") -> "Consensus | None":
+        """The fit-time entry: None unless ``resilience.consensus`` is on AND
+        the runtime is actually multi-process."""
+        import jax
+        if not cfg.resilience.consensus or jax.process_count() <= 1:
+            return None
+        channel_dir = (cfg.resilience.sidechannel_dir
+                       or f"{cfg.train.checkpoint_dir}_sidechannel")
+        return cls(channel_dir, poll_every=cfg.resilience.consensus_poll_steps,
+                   grace_s=cfg.resilience.consensus_grace_s, logger=logger,
+                   tag=tag)
+
+    def _log(self, event: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.consensus(event, tag=self.tag, rank=self.rank,
+                                  **fields)
+
+    # ---------------------------------------------------------- agreement
+
+    def agree(self, flag: bool) -> bool:
+        """OR-reduce a boolean across ranks (collective)."""
+        return agree_any(flag)
+
+    def agree_preempt(self, local: bool, unit: int | None = None) -> bool:
+        """The preemption poll: OR-reduce ``local`` every ``poll_every``
+        units (``unit=None`` forces a poll — epoch boundaries). Once agreed,
+        the latch stays set with no further collectives, so every rank exits
+        through the same preemption path at the same step."""
+        if self._preempt_latch:
+            return True
+        if unit is not None and unit % self.poll_every:
+            return False
+        if self.agree(local):
+            self._preempt_latch = True
+            self._log("preempt_agreed", unit=unit, local=bool(local))
+        return self._preempt_latch
+
+    def agree_restore_step(self, candidates) -> int | None:
+        """The newest durable step EVERY rank verified (None if no overlap):
+        allgather + intersect + max. Each rank may hold a different latest —
+        an async save that landed on some ranks only — so the agreed step is
+        the min of the latests, never newer than any rank can restore."""
+        common = agree_common(candidates)
+        agreed = max(common) if common else None
+        self._log("restore_agreed", step=agreed,
+                  local_latest=(max(candidates) if len(candidates) else None))
+        return agreed
+
+    # ------------------------------------------------------- side-channel
+
+    def poison(self, reason: str) -> None:
+        """Broadcast a poison value (watchdog ``on_fire`` hook; safe to call
+        from the monitor thread — no jax, no collectives)."""
+        self.channel.poison(reason)
+        self._log("poison", reason=str(reason)[:300])
+
+    def peer_exception(self) -> PeerPoisoned | None:
+        """A ``PeerPoisoned`` describing the first peer poison record, or
+        None (watchdog ``peer_check`` hook; monitor-thread safe)."""
+        info = self.channel.peer_poison()
+        if info is None:
+            return None
+        return PeerPoisoned(
+            f"rank {info.get('rank')} poisoned the run: "
+            f"{info.get('reason')!r} — aborting before the next collective "
+            "(restart the job with train.resume=true)")
+
+    def check_peers(self, unit: int | None = None) -> None:
+        """Raise ``PeerPoisoned`` if a peer poisoned the run. Polled from the
+        step loop on the ``poll_every`` cadence (``unit=None`` forces the
+        check); host-side file stat only, no collective."""
+        if unit is not None and unit % self.poll_every:
+            return
+        exc = self.peer_exception()
+        if exc is not None:
+            self._log("peer_poisoned", error=str(exc)[:300])
+            raise exc
+
+    def watchdog_kwargs(self) -> dict:
+        """Wiring for a ``Watchdog`` guarding a collective-entering loop:
+        firing poisons the channel; the monitor polls for peer poison; and a
+        main thread stuck in a wedged collective is exited with
+        ``EXIT_RETRIABLE`` after ``grace_s``."""
+        return {"on_fire": self.poison, "peer_check": self.peer_exception,
+                "escalate_s": self.grace_s, "escalate_code": EXIT_RETRIABLE}

@@ -40,6 +40,11 @@ class FaultPlan:
 
     ``*_at`` step coordinates are GLOBAL step indices within a fit
     (``epoch * steps_per_epoch + i``); epoch coordinates are epoch indices.
+
+    ``rank`` targets the whole plan at ONE process of a multi-process
+    runtime (``None`` = every process) — the consensus test harness pins
+    rank-1-only SIGTERM/NaN/hang/divergent-restore faults with it, asserting
+    that rank 0 still fails in lockstep.
     """
 
     step_exception_at: int | None = None   # raise RuntimeError before step N
@@ -49,6 +54,14 @@ class FaultPlan:
     sigterm_at_epoch_end: int | None = None  # SIGTERM self after epoch N
     truncate_after_save_step: int | None = None  # corrupt the ckpt saved at step N
     nan_loss_at_epoch: int | None = None   # replace epoch N's train loss with NaN
+    # SIGTERM self after N total seed score passes have persisted partials
+    # (the mid-scoring preemption drill: at most one seed's pass is lost).
+    sigterm_after_seed_scores: int | None = None
+    # Drop the newest entry from this rank's durable-candidate list at
+    # consensus restore — as if its final async save never landed (the
+    # divergent-latest-checkpoint drill).
+    hide_latest_durable: bool = False
+    rank: int | None = None                # target process_index (None = all)
 
 
 class FaultInjector:
@@ -56,11 +69,21 @@ class FaultInjector:
         self.plan: FaultPlan | None = None
         self.fired: set[str] = set()
 
+    def _rank_targeted(self) -> bool:
+        """True when this process is the plan's target (always, untargeted).
+        jax imports lazily and only for targeted plans — this module stays
+        importable (and firable single-process) before backend init."""
+        if self.plan.rank is None:
+            return True
+        import jax
+        return jax.process_index() == self.plan.rank
+
     def _due(self, fault: str, coord) -> bool:
-        """True exactly once, when the plan arms ``fault`` at ``coord``."""
+        """True exactly once, when the plan arms ``fault`` at ``coord`` and
+        this process is the targeted rank."""
         if self.plan is None or fault in self.fired:
             return False
-        if getattr(self.plan, fault) != coord:
+        if getattr(self.plan, fault) != coord or not self._rank_targeted():
             return False
         self.fired.add(fault)
         return True
@@ -85,6 +108,9 @@ class FaultInjector:
         elif site == "epoch_end":
             if self._due("sigterm_at_epoch_end", ctx["epoch"]):
                 os.kill(os.getpid(), signal.SIGTERM)
+        elif site == "seed_scored":
+            if self._due("sigterm_after_seed_scores", ctx["completed"]):
+                os.kill(os.getpid(), signal.SIGTERM)
         elif site == "checkpoint_saved":
             if self._due("truncate_after_save_step", ctx["step"]):
                 # Barrier on the async save first: truncating a file that is
@@ -93,9 +119,16 @@ class FaultInjector:
                 truncate_checkpoint(ctx["directory"], ctx["step"])
 
     def transform(self, site: str, value, **ctx):
-        if self.plan is not None and site == "epoch_loss" \
-                and self._due("nan_loss_at_epoch", ctx["epoch"]):
+        if self.plan is None:
+            return value
+        if site == "epoch_loss" and self._due("nan_loss_at_epoch",
+                                              ctx["epoch"]):
             return float("nan")
+        if site == "durable_candidates" and self.plan.hide_latest_durable \
+                and "hide_latest_durable" not in self.fired \
+                and self._rank_targeted() and len(value):
+            self.fired.add("hide_latest_durable")
+            return [s for s in value if s != max(value)]
         return value
 
 

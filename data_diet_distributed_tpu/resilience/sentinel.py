@@ -21,24 +21,47 @@ import math
 
 class DivergenceError(RuntimeError):
     """Training loss went NaN/inf. Carries where, so the recovery event and
-    the rollback target are exact."""
+    the rollback target are exact. ``remote=True`` marks an AGREED divergence
+    on a rank whose own loss was finite (a peer reported the non-finite one —
+    consensus raises everywhere so rollback happens in lockstep)."""
 
-    def __init__(self, value: float, epoch: int, tag: str):
+    def __init__(self, value: float, epoch: int, tag: str,
+                 remote: bool = False):
         self.value = value
         self.epoch = epoch
         self.tag = tag
+        self.remote = remote
+        where = ("agreed across ranks: a peer reported a non-finite loss; "
+                 f"local loss {value!r}" if remote
+                 else f"non-finite train loss ({value!r})")
         super().__init__(
-            f"{tag}: non-finite train loss ({value!r}) at epoch {epoch} — "
-            "divergence; rolling back to the last good checkpoint with a "
-            "reduced LR is the recovery path (resilience.nan_retry_budget)")
+            f"{tag}: {where} at epoch {epoch} — divergence; rolling back to "
+            "the last good checkpoint with a reduced LR is the recovery path "
+            "(resilience.nan_retry_budget)")
 
 
 class LossSentinel:
-    """Per-epoch finiteness gate over the aggregated train loss."""
+    """Per-epoch finiteness gate over the aggregated train loss.
+
+    ``agree`` (the consensus OR-reduce) makes the verdict global: under
+    multi-host a rank-local NaN — a host-side corruption, or rank-targeted
+    injection — must fail EVERY rank at the same epoch boundary, or the
+    diverged rank's rollback desyncs every subsequent collective. The
+    collective runs whenever the sentinel is enabled (config is identical
+    across ranks, so every rank reaches it in lockstep)."""
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
 
-    def check(self, value: float, *, epoch: int, tag: str) -> None:
-        if self.enabled and not math.isfinite(value):
+    def check(self, value: float, *, epoch: int, tag: str,
+              agree=None) -> None:
+        if not self.enabled:
+            return
+        bad = not math.isfinite(value)
+        if agree is not None:
+            agreed_bad = agree(bad)
+            if agreed_bad and not bad:
+                raise DivergenceError(float(value), epoch, tag, remote=True)
+            bad = agreed_bad
+        if bad:
             raise DivergenceError(float(value), epoch, tag)

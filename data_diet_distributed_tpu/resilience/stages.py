@@ -1,0 +1,227 @@
+"""Durable stage manifest: the run/sweep pipeline resumes at the exact stage.
+
+The end-to-end pipeline (per-seed pretrain -> per-seed score pass -> prune ->
+retrain) previously had exactly one durable unit: the retrain's checkpoints.
+Any interruption — preemption, crash, watchdog abort — restarted scoring from
+seed 0 and re-pruned, even when hours of multi-seed scoring had already
+completed. Two pieces make every stage boundary durable:
+
+* ``StageManifest`` — an atomic JSON record (``<checkpoint_dir>_stages.json``)
+  of completed/started stages keyed by a config fingerprint, so a re-invoked
+  ``run``/``sweep`` skips completed stages, resumes a started retrain from
+  its checkpoints, and a CHANGED config (different method, sparsity, seeds,
+  dataset) invalidates the record instead of silently reusing it.
+* ``ScorePartialStore`` — one npz per completed scoring seed
+  (``<checkpoint_dir>_score_partials/seed<k>.npz``, float64 so a resumed
+  mean is bit-identical to an uninterrupted one), validated on load
+  (truncated/corrupt/mismatched files are recomputed, never trusted).
+
+Writes are primary-only and atomic (temp + ``os.replace`` — a kill mid-write
+leaves the previous record, never a truncated one). Under multi-host, the
+loaded manifest is broadcast from rank 0 (``consensus.broadcast_json``) so
+every rank makes identical skip/resume decisions even when the manifest file
+is not visible on every host.
+
+jax is imported lazily (primary gating / broadcast only), keeping
+``resilience`` importable before backend init for the probe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from ..utils.io import atomic_savez
+
+MANIFEST_VERSION = 1
+
+
+def _primary() -> bool:
+    from ..parallel.mesh import is_primary   # lazy: needs jax
+    return is_primary()
+
+
+def stage_manifest_path(checkpoint_dir: str) -> str:
+    """Sibling of the checkpoint dir, like the scores npz — never inside it
+    (Orbax owns the directory's contents)."""
+    return f"{checkpoint_dir}_stages.json"
+
+
+def score_partials_dir(checkpoint_dir: str) -> str:
+    return f"{checkpoint_dir}_score_partials"
+
+
+class StageManifest:
+    """Atomic record of pipeline stage status, keyed by config fingerprint.
+
+    ``enabled=False`` is fully inert (``completed``/``started`` are False,
+    marks are no-ops) so callers thread it unconditionally. All ranks hold
+    the same in-memory state — loaded once (broadcast from rank 0 under
+    multi-host) and updated by every rank at the same pipeline points; only
+    rank 0 writes the file.
+    """
+
+    def __init__(self, path: str, fingerprint: str, *, enabled: bool = True,
+                 logger=None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.enabled = enabled
+        self.logger = logger
+        self._data = {"version": MANIFEST_VERSION, "fingerprint": fingerprint,
+                      "stages": {}}
+        if enabled:
+            self._load()
+
+    def _log(self, stage: str, status: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.stage(stage, status, **fields)
+
+    def _load(self) -> None:
+        data = None
+        try:
+            with open(self.path) as fh:
+                data = json.load(fh)
+            if not isinstance(data.get("stages"), dict):
+                raise ValueError("no stages table")
+        except FileNotFoundError:
+            data = None
+        except (OSError, ValueError) as err:
+            self._log("manifest", "reset", reason=f"unreadable: {err!r}"[:200],
+                      path=self.path)
+            data = None
+        if data is not None and data.get("fingerprint") != self.fingerprint:
+            self._log("manifest", "reset", reason="config fingerprint changed",
+                      path=self.path)
+            data = None
+        from .consensus import broadcast_json
+        data = broadcast_json(data)   # rank 0's view wins on every rank
+        if data is not None:
+            self._data = data
+
+    def _write(self) -> None:
+        if not self.enabled or not _primary():
+            return
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._data, fh, indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+
+    # ------------------------------------------------------------- queries
+
+    def status(self, stage: str) -> str | None:
+        entry = self._data["stages"].get(stage)
+        return entry.get("status") if entry else None
+
+    def completed(self, stage: str) -> bool:
+        return self.enabled and self.status(stage) == "done"
+
+    def started(self, stage: str) -> bool:
+        return self.enabled and self.status(stage) == "started"
+
+    def info(self, stage: str) -> dict | None:
+        return self._data["stages"].get(stage)
+
+    # --------------------------------------------------------------- marks
+
+    def start(self, stage: str, **info) -> None:
+        self._mark(stage, "started", info)
+
+    def complete(self, stage: str, **info) -> None:
+        self._mark(stage, "done", info)
+
+    def _mark(self, stage: str, status: str, info: dict) -> None:
+        if not self.enabled:
+            return
+        entry = dict(self._data["stages"].get(stage) or {})
+        entry.update(info)
+        entry["status"] = status
+        entry["ts"] = round(time.time(), 3)
+        self._data["stages"][stage] = entry
+        self._write()
+        self._log(stage, status)
+
+
+class ScorePartialStore:
+    """Durable per-seed score partials, joined to a dataset by global index.
+
+    Each completed seed's UN-normalized score sum (float64 — the same
+    accumulator ``score_dataset`` uses, so resumed means are bit-identical)
+    is written atomically with enough provenance to refuse reuse across a
+    different method, dataset, row order, or scoring recipe (``fingerprint``
+    — the score-relevant config hash; a partial pretrained under a different
+    LR/arch/epoch-count must recompute, not silently average in). Invalid
+    files — truncated zip, wrong method/seed/indices/fingerprint, non-finite
+    values — load as None and are simply recomputed.
+    """
+
+    def __init__(self, directory: str, *, method: str, indices: np.ndarray,
+                 fingerprint: str = "", logger=None):
+        self.directory = directory
+        self.method = method
+        self.indices = np.asarray(indices)
+        self.fingerprint = fingerprint
+        self.logger = logger
+
+    def path(self, seed: int) -> str:
+        return os.path.join(self.directory, f"seed{int(seed)}.npz")
+
+    def save(self, seed: int, scores: np.ndarray) -> None:
+        if not _primary():
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        atomic_savez(self.path(seed), scores=np.asarray(scores, np.float64),
+                     indices=self.indices, method=self.method,
+                     seed=int(seed), fingerprint=self.fingerprint)
+
+    def load(self, seed: int) -> np.ndarray | None:
+        path = self.path(seed)
+        try:
+            with np.load(path, allow_pickle=False) as d:
+                if not {"scores", "indices", "method", "seed"} <= set(d.files):
+                    raise ValueError("missing arrays")
+                if (str(d["method"]) != self.method
+                        or int(d["seed"]) != int(seed)):
+                    raise ValueError(
+                        f"method/seed mismatch ({d['method']}/{d['seed']})")
+                stored_fp = (str(d["fingerprint"]) if "fingerprint" in d.files
+                             else "")
+                if stored_fp != self.fingerprint:
+                    raise ValueError("scoring-config fingerprint changed")
+                if not np.array_equal(np.asarray(d["indices"]), self.indices):
+                    raise ValueError("dataset indices changed")
+                scores = np.asarray(d["scores"], np.float64)
+        except FileNotFoundError:
+            return None
+        except Exception as err:  # noqa: BLE001 — any invalid partial recomputes
+            if self.logger is not None:
+                self.logger.stage(f"score_seed:{seed}", "invalid",
+                                  path=path, error=repr(err)[:200])
+            return None
+        if scores.shape != self.indices.shape or not np.isfinite(scores).all():
+            if self.logger is not None:
+                self.logger.stage(f"score_seed:{seed}", "invalid", path=path,
+                                  error="wrong shape or non-finite scores")
+            return None
+        return scores
+
+    def load_all(self, seeds) -> dict[int, np.ndarray]:
+        """Every seed with a valid partial. Under multi-host the usable set
+        is the INTERSECTION across ranks (``consensus.agree_common``) so all
+        ranks agree on which seeds to recompute even when the partials dir
+        is not visible everywhere. Collective when multi-process — call at
+        the same point on every rank."""
+        loaded = {int(s): arr for s in seeds
+                  if (arr := self.load(int(s))) is not None}
+        import jax
+        if jax.process_count() > 1:
+            from .consensus import agree_common
+            agreed = agree_common(list(loaded))
+            loaded = {s: arr for s, arr in loaded.items() if s in agreed}
+        return loaded

@@ -28,12 +28,18 @@ bytecode boundary, so a hang inside a native call that never releases the GIL
 is not interruptible in-process — that class is exactly what the SUBPROCESS
 probe exists for. Host-side stalls (data pipeline waits, device sync waits,
 lock/sleep-style blocking) are interruptible and are what the in-process
-watchdog covers.
+watchdog covers. Under multi-host consensus (``resilience/consensus.py``)
+the remaining class — a main thread wedged in a collective whose peer died —
+gets a bounded RETRIABLE EXIT instead: the monitor thread polls the poison
+side-channel (``peer_check``), broadcasts its own firing (``on_fire``), and
+``os._exit``\\ s with a retriable status after ``escalate_s`` when the raise
+cannot land (``escalate_s``/``escalate_code`` constructor wiring).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import signal
 import subprocess
 import sys
@@ -68,14 +74,37 @@ class Watchdog:
     #: handler's SIGTERM/SIGINT.
     SIGNAL = signal.SIGUSR1
 
-    def __init__(self, timeout_s: float, label: str = "section"):
+    def __init__(self, timeout_s: float, label: str = "section", *,
+                 on_fire=None, peer_check=None, escalate_s: float | None = None,
+                 escalate_code: int = 69):
+        """Multi-host consensus wiring (all optional; single-host default is
+        unchanged):
+
+        * ``on_fire(reason)`` — called from the MONITOR thread when the
+          deadline expires, before the raising signal is sent: the consensus
+          layer's poison broadcast, so peers learn about the hang even
+          though this process may never run another line of Python.
+        * ``peer_check()`` — polled each monitor tick; returning an
+          exception makes the watchdog raise IT in the main thread (a peer's
+          poison aborts this rank before its next collective).
+        * ``escalate_s`` — after firing (own expiry or peer poison), if the
+          guarded section is still running this much later, ``os._exit``
+          with ``escalate_code``: the main thread is stuck in a native call
+          the raising handler cannot reach (a wedged collective), and a
+          bounded retriable exit beats an unbounded hang. None = never.
+        """
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
         self.label = label
+        self._on_fire = on_fire
+        self._peer_check = peer_check
+        self._escalate_s = escalate_s
+        self._escalate_code = escalate_code
         self._poll_s = max(0.05, min(1.0, self.timeout_s / 10.0))
         self._deadline = 0.0
         self._fired = False
+        self._pending: BaseException | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._saved = None
@@ -102,21 +131,46 @@ class Watchdog:
             "(silent hang converted to a retriable failure)")
 
     def _on_signal(self, signum, frame):
-        raise self._timeout_error()
+        raise self._pending or self._timeout_error()
 
     def _watch(self) -> None:
         while not self._stop.wait(self._poll_s):
-            if time.monotonic() > self._deadline:
-                self._fired = True
-                # pthread_kill TARGETS THE MAIN THREAD, not raise_signal:
-                # raise_signal delivers to the calling (monitor) thread, which
-                # leaves the main thread's blocking call (sleep, lock, poll)
-                # uninterrupted — the handler would only run after the hang
-                # ended by itself. Delivery to the main thread EINTRs its
-                # blocking call; the handler raises, so the call is not
-                # restarted (PEP 475 only restarts when the handler returns).
-                signal.pthread_kill(threading.main_thread().ident, self.SIGNAL)
-                return
+            peer_exc = None
+            if self._peer_check is not None:
+                try:
+                    peer_exc = self._peer_check()
+                except Exception:   # noqa: BLE001 — a broken check never kills the guard
+                    peer_exc = None
+            expired = time.monotonic() > self._deadline
+            if peer_exc is None and not expired:
+                continue
+            self._fired = True
+            self._pending = peer_exc if peer_exc is not None \
+                else self._timeout_error()
+            if expired and self._on_fire is not None:
+                # OWN expiry only (a peer's poison is already broadcast):
+                # poison best-effort before the raise, from this thread —
+                # the main thread may never run another line of Python.
+                try:
+                    self._on_fire(str(self._pending))
+                except Exception:   # noqa: BLE001
+                    pass
+            # pthread_kill TARGETS THE MAIN THREAD, not raise_signal:
+            # raise_signal delivers to the calling (monitor) thread, which
+            # leaves the main thread's blocking call (sleep, lock, poll)
+            # uninterrupted — the handler would only run after the hang
+            # ended by itself. Delivery to the main thread EINTRs its
+            # blocking call; the handler raises, so the call is not
+            # restarted (PEP 475 only restarts when the handler returns).
+            signal.pthread_kill(threading.main_thread().ident, self.SIGNAL)
+            if self._escalate_s is not None:
+                # The raise lands at the next Python bytecode boundary — a
+                # main thread wedged inside a native collective never
+                # reaches one. Bounded abort: if the guard is still active
+                # after the grace (stop is set by __exit__), exit retriable.
+                if not self._stop.wait(self._escalate_s):
+                    os._exit(self._escalate_code)
+            return
 
     def __enter__(self) -> "Watchdog":
         if threading.current_thread() is not threading.main_thread():
@@ -134,7 +188,8 @@ class Watchdog:
         self._stop.set()
         if self._thread is not None:
             self._thread.join()
-        handled = exc_type is not None and issubclass(exc_type, WatchdogTimeout)
+        handled = exc is not None and (
+            isinstance(exc, WatchdogTimeout) or exc is self._pending)
         if self._fired and not handled:
             # Fired, but the raise has not surfaced in the main thread yet
             # (the guarded block completed, or another exception is already
@@ -147,9 +202,12 @@ class Watchdog:
                     time.sleep(self._poll_s / 10)
             except WatchdogTimeout:
                 pass
+            except Exception as drained:   # noqa: BLE001 — the pending peer raise
+                if drained is not self._pending:
+                    raise
         signal.signal(self.SIGNAL, self._saved)
         if self._fired and exc_type is None:
-            raise self._timeout_error() from None
+            raise (self._pending or self._timeout_error()) from None
         return False
 
 

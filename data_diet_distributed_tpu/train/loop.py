@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -38,9 +39,13 @@ from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
 from ..pruning import select_indices
 from ..resilience import inject
+from ..resilience.consensus import Consensus
 from ..resilience.preemption import Preempted, PreemptionHandler
 from ..resilience.sentinel import DivergenceError, LossSentinel
+from ..resilience.stages import (ScorePartialStore, StageManifest,
+                                 score_partials_dir, stage_manifest_path)
 from ..resilience.watchdog import Watchdog, WatchdogTimeout
+from ..utils.io import atomic_savez
 from .state import TrainState, create_train_state
 from .steps import make_eval_step, make_train_step
 
@@ -148,6 +153,10 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     # optimizer-state sharding over the data axis.
     state = place_state(state, mesh, shard_opt_state=cfg.mesh.shard_opt_state)
 
+    # Multi-host fault consensus (None single-process / disabled): agreed
+    # preemption, agreed divergence, min-agreed restore, poison side-channel.
+    consensus = Consensus.create(cfg, logger=logger, tag=tag)
+
     ckpt = None
     start_epoch = 0
     try:
@@ -156,7 +165,26 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                                      max_to_keep=cfg.train.keep_checkpoints)
             if cfg.train.resume and (resume_step is not None
                                      or ckpt.latest_step() is not None):
-                if cfg.resilience.verify_restore:
+                if consensus is not None:
+                    # Min-agreed restore: each rank's manifest-verified
+                    # candidates are allgathered and intersected; every rank
+                    # restores the newest COMMONLY durable step — never its
+                    # own latest, which an async save may have landed on this
+                    # rank only. Exact-step restore (no per-rank fallback:
+                    # that would desync the ranks agreement protects).
+                    candidates = ckpt.verified_steps(max_step=resume_step)
+                    candidates = inject.transform("durable_candidates",
+                                                  candidates)
+                    used_step = consensus.agree_restore_step(candidates)
+                    if used_step is None:
+                        raise FileNotFoundError(
+                            f"{checkpoint_dir}: no checkpoint step is "
+                            "durable on every rank — nothing all "
+                            f"{consensus.world} ranks can resume from")
+                    state = (ckpt.restore_checked(state, used_step)
+                             if cfg.resilience.verify_restore
+                             else ckpt.restore(state, used_step))
+                elif cfg.resilience.verify_restore:
                     # Manifest-verified restore: a truncated/drifted latest
                     # checkpoint falls back to the newest earlier durable step
                     # (each rejection logged) instead of crashing in Orbax
@@ -222,9 +250,14 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         # (final synchronous checkpoint + Preempted), a missed per-step
         # heartbeat raises a retriable WatchdogTimeout instead of hanging, and
         # a NaN/inf epoch loss raises DivergenceError before the diverged
-        # state is ever checkpointed.
+        # state is ever checkpointed. Under consensus, the watchdog is also
+        # the poison-side-channel agent: firing broadcasts poison, the
+        # monitor polls for peer poison, and a rank wedged in a dead
+        # collective exits retriably after the grace instead of hanging.
         watchdog = (Watchdog(cfg.resilience.step_timeout_s,
-                             label=f"{tag} step loop")
+                             label=f"{tag} step loop",
+                             **(consensus.watchdog_kwargs()
+                                if consensus is not None else {}))
                     if cfg.resilience.step_timeout_s else None)
         preempt = PreemptionHandler(enabled=cfg.resilience.preemption)
         sentinel = LossSentinel(enabled=cfg.resilience.nan_check)
@@ -233,7 +266,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         eval_step, sharder, logger, ckpt, start_epoch,
                         batch_size, tag, result, saved_steps, train_resident,
                         test_resident, steps_per_epoch, epoch_hook,
-                        watchdog=watchdog, preempt=preempt, sentinel=sentinel)
+                        watchdog=watchdog, preempt=preempt, sentinel=sentinel,
+                        consensus=consensus)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -273,11 +307,24 @@ def _preempt_exit(preempt, ckpt, state, logger, tag, epoch, steps_per_epoch,
                     durable_step=durable)
 
 
+def _preempt_due(preempt, consensus, unit=None) -> bool:
+    """The preemption poll. Single-process: the handler's local flag. Under
+    consensus: the flag OR-reduced across ranks (on the poll cadence;
+    ``unit=None`` forces a poll at epoch boundaries), so every rank honors a
+    one-rank SIGTERM at the SAME step — same final checkpoint, same exit 75.
+    Must be reached at the same units on every rank (it is: unit indices are
+    shared loop state)."""
+    local = preempt is not None and preempt.requested
+    if consensus is not None:
+        return consensus.agree_preempt(local, unit=unit)
+    return local
+
+
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
                 saved_steps=None, train_resident=None, test_resident=None,
                 steps_per_epoch=None, epoch_hook=None, watchdog=None,
-                preempt=None, sentinel=None):
+                preempt=None, sentinel=None, consensus=None):
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         shuffle = cfg.data.shuffle_each_epoch
@@ -294,8 +341,13 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         for i, batch in enumerate(batches):
             if watchdog is not None:
                 watchdog.beat()
-            inject.fire("step", epoch=epoch,
-                        step=epoch * steps_per_epoch + i)
+            unit = epoch * steps_per_epoch + i
+            if consensus is not None:
+                # A peer's poison (its watchdog fired) aborts THIS rank here,
+                # before it enters a collective the poisoned peer will never
+                # join — PeerPoisoned instead of an unbounded hang.
+                consensus.check_peers(unit)
+            inject.fire("step", epoch=epoch, step=unit)
             state, metrics = train_step(state, batch)
             step_metrics.append(metrics)
             # Streaming mode: bound dispatch runahead so queued host-uploaded
@@ -307,7 +359,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
             if (i + 1) % cfg.train.log_every_steps == 0:
                 logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
                            loss=float(metrics["loss"]))
-            if preempt is not None and preempt.requested:
+            if _preempt_due(preempt, consensus, unit):
                 result.state = state
                 _preempt_exit(preempt, ckpt, state, logger, tag, epoch - 1,
                               steps_per_epoch, saved_steps, watchdog=watchdog)
@@ -329,7 +381,12 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                                                 epoch=epoch)
         if sentinel is not None:
             try:
-                sentinel.check(record["train_loss"], epoch=epoch, tag=tag)
+                # Under consensus the verdict is OR-reduced: a rank-local NaN
+                # raises on EVERY rank at this same boundary, so rollback
+                # (or the multi-host job restart) happens in lockstep.
+                sentinel.check(record["train_loss"], epoch=epoch, tag=tag,
+                               agree=(consensus.agree if consensus is not None
+                                      else None))
             except DivergenceError:
                 # Detected BEFORE eval/checkpoint: the diverged state is never
                 # made durable, so rollback always lands on a pre-divergence
@@ -371,7 +428,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 watchdog.beat()   # save dispatch (and any barrier it waited on)
         result.state = state
         inject.fire("epoch_end", epoch=epoch)
-        if preempt is not None and preempt.requested:
+        if _preempt_due(preempt, consensus):   # epoch boundary: forced poll
             _preempt_exit(preempt, ckpt, state, logger, tag, epoch,
                           steps_per_epoch, saved_steps,
                           already_durable=int(state.step) if save_now else None,
@@ -490,7 +547,8 @@ def load_data_for(cfg: Config):
 
 
 def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
-                              mesh, sharder, logger) -> list[dict]:
+                              mesh, sharder, logger,
+                              seeds=None) -> list[dict]:
     """Produce one scoring-model variable pytree per seed.
 
     Each seed trains a fresh model for ``score.pretrain_epochs`` epochs (the paper
@@ -499,7 +557,13 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     If ``score.score_ckpt_step`` is set, an existing checkpoint from
     ``train.checkpoint_dir`` is loaded instead — the configurable version of the
     reference's fixed epoch-19 checkpoint.
+
+    ``seeds`` (default ``cfg.score.seeds``): pretrain only this subset — the
+    stage-resume path passes the seeds whose score passes are still
+    incomplete, so completed seeds' pretrains are never re-paid.
     """
+    if seeds is None:
+        seeds = cfg.score.seeds
     if cfg.score.score_ckpt_step is not None:
         template = create_train_state(cfg, jax.random.key(0), steps_per_epoch=1)
         mngr = CheckpointManager(cfg.train.checkpoint_dir,
@@ -515,7 +579,7 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     shared_resident = None
     if cfg.score.pretrain_epochs > 0:
         shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
-    for s in cfg.score.seeds:
+    for s in seeds:
         if cfg.score.pretrain_epochs > 0:
             res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
                       num_epochs=cfg.score.pretrain_epochs, seed=int(s),
@@ -531,7 +595,8 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
 
 
 def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
-                      mesh, sharder, logger) -> np.ndarray:
+                      mesh, sharder, logger, partials=None,
+                      preloaded=None) -> np.ndarray:
     """Trajectory scores: forgetting events (Toneva et al. 2019) or
     area-under-margin (Pleiss et al. 2020) — ``ops/forgetting.py``.
 
@@ -574,44 +639,73 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
     batch_size = sharder.global_batch_size_for(cfg.data.batch_size)
     shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
     total = np.zeros(n, np.float64)
-    for s in cfg.score.seeds:
-        tracker = make_tracker(n)
+    # Stage resume (``partials``, a ScorePartialStore): completed seeds'
+    # trajectory scores load from their durable partials; each finished seed
+    # persists before the next starts; a SIGTERM between seeds exits cleanly
+    # at the boundary — at most the in-flight seed's trajectory is lost.
+    # ``preloaded``: the partials already loaded by the caller (load_all is
+    # a collective under multi-host — it must run exactly once).
+    done = preloaded if preloaded is not None else (
+        partials.load_all(cfg.score.seeds) if partials is not None else {})
+    if done:
+        logger.log("score_seeds_resumed", method=method,
+                   done=sorted(done), todo=[int(s) for s in cfg.score.seeds
+                                            if int(s) not in done])
+    preempt = PreemptionHandler(enabled=(partials is not None
+                                         and cfg.resilience.preemption))
+    completed = len(done)
+    with preempt:
+        for s in cfg.score.seeds:
+            if int(s) in done:
+                total += done[int(s)]
+                continue
+            tracker = make_tracker(n)
 
-        def hook(model_, state, epoch, tracker=tracker):
-            batches = (shared_resident(shuffle=False)
-                       if shared_resident is not None else
-                       (db for _, db in device_stream(
-                           train_ds, batch_size, sharder)))
-            # Bounded dispatch window in streaming mode so queued uploads
-            # can't pin every batch in HBM (same pattern as evaluate /
-            # score_dataset); resident batches live on device -> one flush.
-            window = 1 << 30 if shared_resident is not None else 8
-            chunks: list[np.ndarray] = []
-            pending: list = []
+            def hook(model_, state, epoch, tracker=tracker):
+                batches = (shared_resident(shuffle=False)
+                           if shared_resident is not None else
+                           (db for _, db in device_stream(
+                               train_ds, batch_size, sharder)))
+                # Bounded dispatch window in streaming mode so queued uploads
+                # can't pin every batch in HBM (same pattern as evaluate /
+                # score_dataset); resident batches live on device -> one flush.
+                window = 1 << 30 if shared_resident is not None else 8
+                chunks: list[np.ndarray] = []
+                pending: list = []
 
-            def flush():
-                chunks.extend(np.asarray(a) for a in _to_host(pending))
-                pending.clear()
+                def flush():
+                    chunks.extend(np.asarray(a) for a in _to_host(pending))
+                    pending.clear()
 
-            for b in batches:
-                pending.append(step(state.variables, b))
-                if len(pending) >= window:
-                    flush()
-            flush()
-            tracker.update(to_obs(np.concatenate(chunks)[:n]))
+                for b in batches:
+                    pending.append(step(state.variables, b))
+                    if len(pending) >= window:
+                        flush()
+                flush()
+                tracker.update(to_obs(np.concatenate(chunks)[:n]))
 
-        fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
-            num_epochs=cfg.score.pretrain_epochs, seed=int(s),
-            tag=f"{method}_seed{s}", train_resident=shared_resident,
-            epoch_hook=hook)
-        rec = {"seed": int(s), "epochs": tracker.updates}
-        if method == "forgetting":
-            rec.update(never_learned=int((~tracker.learned).sum()),
-                       mean_events=float(tracker.counts.mean()))
-        else:
-            rec.update(mean_margin=float(tracker.scores().mean()))
-        logger.log(f"{method}_seed_done", **rec)
-        total += tracker.scores()
+            fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
+                num_epochs=cfg.score.pretrain_epochs, seed=int(s),
+                tag=f"{method}_seed{s}", train_resident=shared_resident,
+                epoch_hook=hook)
+            rec = {"seed": int(s), "epochs": tracker.updates}
+            if method == "forgetting":
+                rec.update(never_learned=int((~tracker.learned).sum()),
+                           mean_events=float(tracker.counts.mean()))
+            else:
+                rec.update(mean_margin=float(tracker.scores().mean()))
+            logger.log(f"{method}_seed_done", **rec)
+            seed_scores = np.asarray(tracker.scores(), np.float64)
+            total += seed_scores
+            completed += 1
+            if partials is not None:
+                partials.save(int(s), seed_scores)
+                inject.fire("seed_scored", seed=int(s), completed=completed)
+                if preempt.requested:
+                    # Seed-boundary preemption: this seed's partial is
+                    # durable; the clean Preempted exit (CLI 75) loses
+                    # nothing — resume starts at the next seed.
+                    raise Preempted(preempt.signame)
     return (total / len(cfg.score.seeds)).astype(np.float32)
 
 
@@ -619,8 +713,25 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
 forgetting_scores = trajectory_scores
 
 
-def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
-                   mesh, sharder, logger) -> tuple[np.ndarray, dict[str, float]]:
+def _score_partial_store(cfg: Config, train_ds: ArrayDataset, logger,
+                         stages) -> ScorePartialStore | None:
+    """The per-seed partial store when stage resume applies: on, multi-seed,
+    not a fixed-checkpoint pass (one cheap unit — nothing to resume), and no
+    duplicate seeds (partials key by seed value)."""
+    seeds = [int(s) for s in cfg.score.seeds]
+    if (stages is None or not getattr(stages, "enabled", False)
+            or cfg.score.score_ckpt_step is not None
+            or len(seeds) != len(set(seeds))):
+        return None
+    return ScorePartialStore(score_partials_dir(cfg.train.checkpoint_dir),
+                             method=cfg.score.method,
+                             indices=train_ds.indices,
+                             fingerprint=score_fingerprint(cfg),
+                             logger=logger)
+
+
+def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
+                   logger, stages=None) -> tuple[np.ndarray, dict[str, float]]:
     """Dispatch the configured scoring method to its driver: checkpoint-based
     scores (EL2N / GraNd family) go through ``score_dataset`` over per-seed
     scoring models; trajectory-based forgetting scores train-and-track.
@@ -633,51 +744,143 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *,
     ``score.scores_npz``: load scores from a saved artifact instead of
     computing — prune/retrain experiments then pay zero scoring cost. The
     npz's global indices are joined to the dataset's, so subsets and
-    reorderings are handled; missing examples refuse loudly.
+    reorderings are handled; missing examples refuse loudly, and a method
+    mismatch (EL2N scores into a GraNd experiment) refuses by name.
+
+    ``stages`` (a StageManifest) arms stage resume: every completed seed's
+    score pass persists a durable partial npz
+    (``<checkpoint_dir>_score_partials/seed<k>.npz``, float64 — a resumed
+    mean is bit-identical to an uninterrupted one), a SIGTERM mid-scoring
+    exits cleanly at the next seed boundary (``Preempted``/75), and
+    re-invocation pretrains + scores only the incomplete seeds.
     """
     t0 = time.perf_counter()
     if cfg.score.scores_npz:
-        scores = load_scores_npz(cfg.score.scores_npz, train_ds)
+        scores = load_scores_npz(cfg.score.scores_npz, train_ds,
+                                 expect_method=cfg.score.method)
         logger.log("scores_loaded", path=cfg.score.scores_npz, n=len(scores))
         return scores, {"pretrain_s": 0.0,
                         "score_s": time.perf_counter() - t0,
                         "loaded_from": cfg.score.scores_npz}
+    partials = _score_partial_store(cfg, train_ds, logger, stages)
+    seeds = [int(s) for s in cfg.score.seeds]
     if cfg.score.method in ("forgetting", "aum"):
+        done = partials.load_all(seeds) if partials is not None else {}
         scores = trajectory_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                                   logger=logger)
-        return scores, {"pretrain_s": 0.0, "score_s": time.perf_counter() - t0}
-    seeds_vars = score_variables_for_seeds(cfg, train_ds, mesh=mesh,
-                                           sharder=sharder, logger=logger)
-    pretrain_s = time.perf_counter() - t0
-    model = create_model_from_cfg(cfg)
-    t1 = time.perf_counter()
-    scores = score_dataset(model, seeds_vars, train_ds,
-                           method=cfg.score.method,
-                           batch_size=cfg.score.batch_size,
-                           sharder=sharder, chunk=cfg.score.grand_chunk,
-                           eval_mode=cfg.score.eval_mode,
-                           use_pallas=cfg.score.use_pallas)
-    return scores, {"pretrain_s": pretrain_s,
-                    "score_s": time.perf_counter() - t1}
+                                   logger=logger, partials=partials,
+                                   preloaded=done)
+        return scores, {"pretrain_s": 0.0,
+                        "score_s": time.perf_counter() - t0,
+                        # Computed (not resumed-from-partial) trajectory
+                        # passes — a mostly-resumed run must not log a
+                        # 10x-inflated scoring rate.
+                        "passes": len([s for s in seeds if s not in done])}
+    done = partials.load_all(seeds) if partials is not None else {}
+    todo = [s for s in seeds if s not in done]
+    if done:
+        logger.log("score_seeds_resumed", method=cfg.score.method,
+                   done=sorted(done), todo=todo)
+    total = np.zeros(len(train_ds), np.float64)
+    for arr in done.values():
+        total += arr
+    pretrain_s = score_s = 0.0
+    passes = 0
+    if todo:
+        preempt = PreemptionHandler(enabled=(partials is not None
+                                             and cfg.resilience.preemption))
+        with preempt:
+            seeds_vars = score_variables_for_seeds(
+                cfg, train_ds, mesh=mesh, sharder=sharder, logger=logger,
+                seeds=todo if partials is not None else None)
+            pretrain_s = time.perf_counter() - t0
+            model = create_model_from_cfg(cfg)
+            t1 = time.perf_counter()
+
+            def on_seed_done(k, seed_scores):
+                # Accumulate the exact float64 per-seed sum (NOT the f32
+                # mean score_dataset returns): a resumed run adds the same
+                # f64 arrays — loaded from partials — in the same order, so
+                # interrupted and uninterrupted runs are bit-identical.
+                total[:] += seed_scores
+                if partials is None:
+                    return
+                partials.save(todo[k], seed_scores)
+                inject.fire("seed_scored", seed=todo[k],
+                            completed=len(done) + k + 1)
+                if preempt.requested:
+                    # Seed-boundary preemption: the just-finished seed's
+                    # partial is durable — the clean Preempted exit (CLI 75)
+                    # loses at most the NEXT seed's in-flight work; resume
+                    # recomputes only the incomplete seeds.
+                    raise Preempted(preempt.signame)
+
+            score_dataset(model, seeds_vars, train_ds,
+                          method=cfg.score.method,
+                          batch_size=cfg.score.batch_size,
+                          sharder=sharder, chunk=cfg.score.grand_chunk,
+                          eval_mode=cfg.score.eval_mode,
+                          use_pallas=cfg.score.use_pallas,
+                          on_seed_done=on_seed_done)
+            score_s = time.perf_counter() - t1
+        passes = len(seeds_vars)
+    divisor = len(seeds) if partials is not None else max(passes, 1)
+    scores = (total / divisor).astype(np.float32)
+    if stages is not None:
+        stages.complete("score", method=cfg.score.method, n=int(len(scores)),
+                        reused_seeds=sorted(done))
+    return scores, {"pretrain_s": pretrain_s, "score_s": score_s,
+                    "passes": passes}
 
 
-def load_scores_npz(path: str, train_ds: ArrayDataset) -> np.ndarray:
+def load_scores_npz(path: str, train_ds: ArrayDataset,
+                    expect_method: str | None = None) -> np.ndarray:
     """Scores from a saved artifact, re-joined to ``train_ds`` row order by
     GLOBAL index (the artifact may cover a superset or a different ordering of
     the dataset; any dataset example missing from the artifact refuses
-    loudly via the position joiner's KeyError)."""
+    loudly via the position joiner's KeyError).
+
+    A truncated or corrupt file (a crash mid-write predating the atomic
+    writers, flaky storage) raises a ``ValueError`` NAMING THE PATH instead
+    of an opaque zip/zlib deserialization error. ``expect_method``: refuse an
+    artifact whose recorded scoring method differs — reusing EL2N scores for
+    a GraNd experiment would silently mix scoring methods. Artifacts without
+    a recorded method (pre-provenance) and ``reused:``-provenance records
+    (already reused once — the original method is unrecoverable) load
+    unchecked."""
+    import zipfile
+    import zlib
+
     from ..data.datasets import make_position_joiner
 
-    with np.load(path) as d:
-        if "scores" not in d or "indices" not in d:
-            raise ValueError(
-                f"{path} is not a scores artifact (needs 'scores' and "
-                "'indices' arrays, as written by the run/score/sweep commands)")
-        scores, indices = np.asarray(d["scores"]), np.asarray(d["indices"])
+    try:
+        with np.load(path, allow_pickle=False) as d:
+            present = set(d.files)
+            scores = (np.asarray(d["scores"]) if "scores" in present else None)
+            indices = (np.asarray(d["indices"]) if "indices" in present
+                       else None)
+            method = str(d["method"]) if "method" in present else None
+    except FileNotFoundError:
+        raise
+    except (OSError, EOFError, ValueError, zipfile.BadZipFile,
+            zlib.error) as err:
+        raise ValueError(
+            f"{path}: truncated or corrupt scores artifact ({err!r}) — "
+            "recompute the scores (unset score.scores_npz) or point at an "
+            "intact artifact") from err
+    if scores is None or indices is None:
+        raise ValueError(
+            f"{path} is not a scores artifact (needs 'scores' and "
+            "'indices' arrays, as written by the run/score/sweep commands)")
     if scores.shape != indices.shape:
         raise ValueError(
             f"{path}: scores shape {scores.shape} does not match indices "
             f"shape {indices.shape} — truncated or malformed artifact")
+    if (expect_method is not None and method is not None
+            and not method.startswith("reused:") and method != expect_method):
+        raise ValueError(
+            f"{path} holds {method!r} scores but this run is configured for "
+            f"score.method={expect_method!r} — reusing them would silently "
+            f"mix scoring methods; set score.method={method} or recompute")
     pos = make_position_joiner(indices)(train_ds.indices)
     return scores[pos].astype(np.float32)
 
@@ -694,10 +897,71 @@ def _score_passes(cfg: Config) -> int:
     return 1 if cfg.score.score_ckpt_step is not None else len(cfg.score.seeds)
 
 
+def _score_fingerprint_key(cfg: Config) -> dict:
+    """The config fields a per-example SCORE depends on — everything that
+    shapes the scoring pretrain trajectory and the score math, and nothing
+    that doesn't (prune/retrain knobs: scores are sparsity-independent, the
+    property the sweep's shared scoring pass rests on; ``train.num_epochs``
+    is the RETRAIN horizon — the pretrain's cosine horizon is
+    ``pretrain_epochs`` via ``_with_epochs``)."""
+    return {
+        "data": [cfg.data.dataset, cfg.data.data_dir, cfg.data.batch_size,
+                 cfg.data.synthetic_size, cfg.data.synthetic_noise,
+                 cfg.data.synthetic_clusters, cfg.data.augment,
+                 cfg.data.shuffle_each_epoch],
+        "model": [cfg.model.arch, cfg.model.stem],
+        "optim": [cfg.optim.lr, cfg.optim.momentum, cfg.optim.weight_decay,
+                  cfg.optim.warmup_epochs, cfg.optim.cosine_t_max_epochs],
+        "score": [cfg.score.method, cfg.score.pretrain_epochs,
+                  cfg.score.score_ckpt_step, cfg.score.scores_npz,
+                  cfg.score.eval_mode],
+        "half_precision": cfg.train.half_precision,
+    }
+
+
+def _hash_key(key: dict) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def score_fingerprint(cfg: Config) -> str:
+    """Provenance hash stored in each per-seed score partial: a partial
+    computed under a different scoring recipe must recompute, never silently
+    average into a resumed pass. Per-SEED artifacts, so the seed list itself
+    is excluded (adding seeds must reuse the already-computed ones)."""
+    return _hash_key(_score_fingerprint_key(cfg))
+
+
+def pipeline_fingerprint(cfg: Config) -> str:
+    """Fingerprint of every config field that determines what the run/sweep
+    pipeline COMPUTES (not where it logs): a stage manifest written under a
+    different method/sparsity/dataset/recipe must invalidate, never silently
+    satisfy, a resumed run."""
+    key = dict(
+        _score_fingerprint_key(cfg),
+        seeds=[int(s) for s in cfg.score.seeds],
+        prune=[cfg.prune.sparsity, cfg.prune.keep, cfg.prune.class_balance,
+               list(cfg.prune.sweep)],
+        train=[cfg.train.num_epochs, cfg.train.seed],
+    )
+    return _hash_key(key)
+
+
+def pipeline_stages(cfg: Config, logger) -> StageManifest:
+    """The run/sweep stage manifest (inert when ``resilience.stage_resume``
+    is off) — ``<train.checkpoint_dir>_stages.json``, keyed by
+    ``pipeline_fingerprint``."""
+    return StageManifest(stage_manifest_path(cfg.train.checkpoint_dir),
+                         pipeline_fingerprint(cfg),
+                         enabled=cfg.resilience.stage_resume, logger=logger)
+
+
 def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                    mesh, sharder, logger, ckpt_dir: str, tag: str,
-                   score_t: dict[str, float],
-                   scoring_shared: bool = False) -> dict[str, Any]:
+                   score_t: dict[str, float], scoring_shared: bool = False,
+                   stages: StageManifest | None = None) -> dict[str, Any]:
     """Shared prune→save-npz→retrain→summary block for one sparsity level
     (used by ``run_datadiet`` and each ``run_sweep`` level).
 
@@ -705,7 +969,18 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
     sweep) — the per-level summary still records the shared pretrain/score
     walls for reference, but ``total_wall_s`` charges only this level's
     retrain; the sweep's true end-to-end wall is logged once by ``run_sweep``.
+
+    ``stages``: a completed ``retrain:<tag>`` stage returns its recorded
+    summary without retraining (an interrupted sweep skips finished levels);
+    a STARTED one resumes the retrain from its own checkpoints instead of
+    restarting epoch 0.
     """
+    stage = f"retrain:{tag}"
+    if stages is not None and stages.completed(stage):
+        summary = stages.info(stage).get("summary") or {}
+        logger.stage(stage, "skipped", sparsity=float(sparsity),
+                     final_test_accuracy=summary.get("final_test_accuracy"))
+        return summary
     kept = select_indices(scores, train_ds.indices, sparsity,
                           keep=cfg.prune.keep, seed=cfg.train.seed,
                           labels=train_ds.labels,
@@ -715,26 +990,41 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
     loaded_from = score_t.get("loaded_from")
     method = f"reused:{loaded_from}" if loaded_from else cfg.score.method
     if is_primary():   # every process holds the full scores; one writes
-        np.savez(scores_npz_path(ckpt_dir), scores=scores,
-                 indices=train_ds.indices, kept=kept, keep=cfg.prune.keep,
-                 class_balance=cfg.prune.class_balance, method=method)
+        # Atomic (temp + rename): a crash mid-write must never leave a
+        # truncated npz that a later score.scores_npz reuse trusts.
+        atomic_savez(scores_npz_path(ckpt_dir), scores=scores,
+                     indices=train_ds.indices, kept=kept, keep=cfg.prune.keep,
+                     class_balance=cfg.prune.class_balance, method=method)
     score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
     prune_rec = dict(n_total=len(train_ds), n_kept=len(kept),
                      score_s=round(score_s, 3),
                      pretrain_s=round(pretrain_s, 3))
-    if not loaded_from:
+    passes = score_t.get("passes", _score_passes(cfg))
+    if not loaded_from and passes and score_s > 0:
         # An npz load in milliseconds is not a scoring rate — omit rather
-        # than log an absurd number.
-        prune_rec["score_examples_per_s"] = (
-            len(train_ds) * _score_passes(cfg) / score_s)
+        # than log an absurd number (likewise a fully-resumed scoring pass).
+        prune_rec["score_examples_per_s"] = len(train_ds) * passes / score_s
     logger.log("prune", **prune_rec)
-    res = fit_with_recovery(cfg, train_ds.subset(kept), test_ds, mesh=mesh,
-                            sharder=sharder, logger=logger,
+    if stages is not None:
+        stages.complete(f"prune:{tag}", n_kept=int(len(kept)),
+                        sparsity=float(sparsity))
+    cfg_retrain = cfg
+    if stages is not None and stages.started(stage) and not cfg.train.resume:
+        # This exact stage was interrupted mid-retrain: re-enter from its own
+        # durable checkpoints. (Never set on a FRESH stage — its directory's
+        # checkpoints, if any, belong to an invalidated earlier config.)
+        cfg_retrain = copy.deepcopy(cfg)
+        cfg_retrain.train.resume = True
+        logger.stage(stage, "resuming", ckpt_dir=ckpt_dir)
+    if stages is not None:
+        stages.start(stage, ckpt_dir=ckpt_dir)
+    res = fit_with_recovery(cfg_retrain, train_ds.subset(kept), test_ds,
+                            mesh=mesh, sharder=sharder, logger=logger,
                             checkpoint_dir=ckpt_dir, tag=tag)
     summary = {
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
         "sparsity": float(sparsity), "score_method": method,
-        "n_kept": len(kept), "score_wall_s": score_s,
+        "n_kept": int(len(kept)), "score_wall_s": score_s,
         "pretrain_wall_s": pretrain_s,
         "final_test_accuracy": res.final_test_accuracy,
         "train_wall_s": res.wall_s,
@@ -744,6 +1034,8 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
     if scoring_shared:
         summary["scoring_shared"] = True
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
+    if stages is not None:
+        stages.complete(stage, summary=summary)
     return summary
 
 
@@ -785,9 +1077,10 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
     mesh = make_mesh(cfg.mesh)
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
+    stages = pipeline_stages(cfg, logger)
 
     scores, score_t = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
-                                     logger=logger)
+                                     logger=logger, stages=stages)
     logger.log("sweep_scored", n=len(train_ds),
                score_s=round(score_t["score_s"], 3),
                pretrain_s=round(score_t["pretrain_s"], 3),
@@ -800,7 +1093,7 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
             sharder=sharder, logger=logger,
             ckpt_dir=sweep_level_dir(cfg.train.checkpoint_dir, sparsity),
             tag=f"final_{sweep_suffix(sparsity)}", score_t=score_t,
-            scoring_shared=True))
+            scoring_shared=True, stages=stages))
     logger.log("sweep_done", levels=list(sweep),
                total_wall_s=round(score_t["pretrain_s"] + score_t["score_s"]
                                   + sum(s["train_wall_s"] for s in summaries),
@@ -809,24 +1102,45 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
 
 
 def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, Any]:
-    """End-to-end: (pretrain →) score → prune → retrain-from-scratch → final eval."""
+    """End-to-end: (pretrain →) score → prune → retrain-from-scratch → final eval.
+
+    Stage-resumable (``resilience.stage_resume``): every stage boundary is
+    durable — per-seed score partials, the prune artifact, the retrain's own
+    checkpoints, and a stage manifest recording what completed — so a
+    preempted (exit 75) or crashed run re-invoked with the same config
+    re-enters at the exact stage instead of re-scoring from seed 0."""
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
     mesh = make_mesh(cfg.mesh)
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
+    stages = pipeline_stages(cfg, logger)
 
     t0 = time.perf_counter()
     if cfg.prune.sparsity > 0.0:
         scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
-                                         sharder=sharder, logger=logger)
+                                         sharder=sharder, logger=logger,
+                                         stages=stages)
         return _retrain_level(cfg, train_ds, test_ds, scores,
                               cfg.prune.sparsity, mesh=mesh, sharder=sharder,
                               logger=logger,
                               ckpt_dir=cfg.train.checkpoint_dir,
-                              tag="final", score_t=score_t)
+                              tag="final", score_t=score_t, stages=stages)
 
-    res = fit_with_recovery(cfg, train_ds, test_ds, mesh=mesh, sharder=sharder,
-                            logger=logger, checkpoint_dir=cfg.train.checkpoint_dir,
+    stage = "dense:final"
+    if stages.completed(stage):
+        summary = stages.info(stage).get("summary") or {}
+        logger.stage(stage, "skipped",
+                     final_test_accuracy=summary.get("final_test_accuracy"))
+        return summary
+    cfg_dense = cfg
+    if stages.started(stage) and not cfg.train.resume:
+        cfg_dense = copy.deepcopy(cfg)
+        cfg_dense.train.resume = True
+        logger.stage(stage, "resuming", ckpt_dir=cfg.train.checkpoint_dir)
+    stages.start(stage, ckpt_dir=cfg.train.checkpoint_dir)
+    res = fit_with_recovery(cfg_dense, train_ds, test_ds, mesh=mesh,
+                            sharder=sharder, logger=logger,
+                            checkpoint_dir=cfg.train.checkpoint_dir,
                             tag="final")
     summary = {
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
@@ -836,4 +1150,5 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         "total_wall_s": time.perf_counter() - t0,
     }
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
+    stages.complete(stage, summary=summary)
     return summary

@@ -1,4 +1,5 @@
+from .io import atomic_savez
 from .stats import pearson, spearman
 from .trees import param_count, tree_bytes
 
-__all__ = ["pearson", "spearman", "param_count", "tree_bytes"]
+__all__ = ["atomic_savez", "pearson", "spearman", "param_count", "tree_bytes"]

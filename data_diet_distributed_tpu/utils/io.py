@@ -1,0 +1,21 @@
+"""Atomic artifact writes (write-then-rename).
+
+A kill or preemption mid-``np.savez`` leaves a truncated zip that a later
+``score.scores_npz`` reuse (or stage resume) would try to deserialize. Every
+scores/partials artifact therefore lands via temp file + ``os.replace``: the
+destination path only ever holds a complete file or the previous one.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    """``np.savez`` to ``path`` atomically. The temp file lives in the same
+    directory (``os.replace`` must not cross filesystems)."""
+    tmp = f"{path}.tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)

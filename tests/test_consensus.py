@@ -1,0 +1,270 @@
+"""Consensus primitives, unit-level (single-process; the real 2-process
+drills live in the slow lane, ``test_consensus_multihost.py``): degenerate
+single-process behavior, the poison side-channel, watchdog peer/escalation
+wiring, rank-targeted injection, and the checkpoint agreement surface."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.resilience.consensus import (
+    EXIT_RETRIABLE, Consensus, PeerPoisoned, SideChannel, agree_any,
+    agree_common, broadcast_json)
+from data_diet_distributed_tpu.resilience.sentinel import (DivergenceError,
+                                                           LossSentinel)
+from data_diet_distributed_tpu.resilience.watchdog import (Watchdog,
+                                                           WatchdogTimeout)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    inject.deactivate()
+
+
+# ----------------------------------------------------- primitives (1-proc)
+
+
+def test_agreement_primitives_single_process_identity():
+    assert agree_any(True) is True
+    assert agree_any(False) is False
+    assert agree_common([8, 4, 4]) == {4, 8}
+    assert agree_common([]) == set()
+    obj = {"stages": {"x": {"status": "done"}}}
+    assert broadcast_json(obj) == obj
+    assert broadcast_json(None) is None
+
+
+def test_consensus_create_is_none_single_process(tiny_cfg):
+    assert Consensus.create(tiny_cfg) is None
+    tiny_cfg.resilience.consensus = False
+    assert Consensus.create(tiny_cfg) is None
+
+
+def test_consensus_direct_single_process(tmp_path):
+    """Constructed directly (the multi-host ctor path), a 1-process Consensus
+    degrades to local verdicts — and the preempt latch sticks."""
+    c = Consensus(str(tmp_path / "chan"), poll_every=4)
+    assert c.agree(False) is False
+    assert c.agree(True) is True
+    assert c.agree_restore_step([4, 8]) == 8
+    assert c.agree_restore_step([]) is None
+    # Off-cadence units never poll; unit=None (epoch boundary) forces it.
+    assert c.agree_preempt(True, unit=3) is False
+    assert c.agree_preempt(True, unit=4) is True
+    assert c.agree_preempt(False, unit=5) is True   # latched, no more polls
+
+
+def test_side_channel_poison_roundtrip(tmp_path):
+    d = str(tmp_path / "chan")
+    r0, r1 = SideChannel(d, 0), SideChannel(d, 1)
+    r0.open(), r1.open()
+    assert r0.peer_poison() is None
+    r1.poison("rank 1 watchdog: no heartbeat within 8s")
+    info = r0.peer_poison()
+    assert info["rank"] == 1 and "heartbeat" in info["reason"]
+    assert r1.peer_poison() is None      # own poison is not a peer's
+    # Re-open clears the rank's own stale poison (fresh attempt).
+    r1.open()
+    assert r0.peer_poison() is None
+    # No leftover temp files (atomic rename).
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+
+
+def test_consensus_check_peers_raises_and_logs(tmp_path):
+    c = Consensus(str(tmp_path / "chan"), poll_every=2)
+    c.check_peers(0)                       # clean: no poison
+    SideChannel(str(tmp_path / "chan"), 7).poison("injected")
+    c.check_peers(1)                       # off-cadence: not polled
+    with pytest.raises(PeerPoisoned, match="rank 7"):
+        c.check_peers(2)
+    with pytest.raises(PeerPoisoned):      # unit=None forces the check
+        c.check_peers()
+
+
+# ------------------------------------------------------- watchdog wiring
+
+
+def test_watchdog_on_fire_broadcasts_before_raise():
+    fired = []
+    with pytest.raises(WatchdogTimeout):
+        with Watchdog(timeout_s=0.3, label="unit",
+                      on_fire=lambda reason: fired.append(reason)):
+            time.sleep(30)
+    assert fired and "no heartbeat" in fired[0]
+
+
+def test_watchdog_peer_check_raises_peer_exception():
+    """Peer poison raises through the watchdog even though the deadline never
+    expired — the abort-before-the-dead-collective path."""
+    poison = PeerPoisoned("rank 1 poisoned the run")
+    seen = threading.Event()
+
+    def peer_check():
+        return poison if seen.is_set() else None
+
+    t0 = time.monotonic()
+    with pytest.raises(PeerPoisoned, match="rank 1"):
+        with Watchdog(timeout_s=60.0, label="unit", peer_check=peer_check) as wd:
+            wd.beat()
+            seen.set()
+            time.sleep(30)
+    assert time.monotonic() - t0 < 20.0
+
+
+def test_watchdog_escalates_stuck_main_thread_with_retriable_exit():
+    """A main thread the raise cannot unstick (simulated by swallowing the
+    raise and blocking again) is os._exit'ed with EXIT_RETRIABLE after the
+    grace — bounded abort instead of an unbounded wedge. Subprocess: os._exit
+    must not kill the test runner."""
+    code = (
+        "import time\n"
+        "from data_diet_distributed_tpu.resilience.watchdog import ("
+        "Watchdog, WatchdogTimeout)\n"
+        "with Watchdog(timeout_s=0.3, label='wedge', escalate_s=0.5,"
+        " escalate_code=69):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            time.sleep(30)\n"
+        "        except WatchdogTimeout:\n"
+        "            pass\n"       # simulate a raise that cannot land
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run([sys.executable, "-c", code], timeout=60,
+                          capture_output=True, text=True, env=env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == EXIT_RETRIABLE, proc.stderr[-500:]
+
+
+def test_consensus_watchdog_kwargs_wire_the_channel(tmp_path):
+    c = Consensus(str(tmp_path / "chan"), grace_s=5.0)
+    kw = c.watchdog_kwargs()
+    assert kw["escalate_s"] == 5.0 and kw["escalate_code"] == EXIT_RETRIABLE
+    kw["on_fire"]("deadline expired")             # poisons the channel
+    assert SideChannel(str(tmp_path / "chan"), 9).peer_poison()["rank"] == 0
+    exc = kw["peer_check"]()
+    assert exc is None                            # own poison is not a peer's
+
+
+# -------------------------------------------------------- sentinel agree
+
+
+def test_sentinel_agreed_divergence_remote_and_local():
+    s = LossSentinel()
+    s.check(1.0, epoch=0, tag="t", agree=lambda bad: False)
+    # A peer's NaN (agree says True, local finite): remote provenance.
+    with pytest.raises(DivergenceError, match="peer") as exc_info:
+        s.check(1.0, epoch=3, tag="t", agree=lambda bad: True)
+    assert exc_info.value.remote is True and exc_info.value.epoch == 3
+    # Local NaN under agreement: ordinary (non-remote) divergence.
+    with pytest.raises(DivergenceError) as exc_info:
+        s.check(float("nan"), epoch=1, tag="t", agree=lambda bad: bad)
+    assert exc_info.value.remote is False
+    # Disabled: no collective, no raise (every rank skips consistently).
+    calls = []
+    LossSentinel(enabled=False).check(float("nan"), epoch=0, tag="t",
+                                      agree=lambda b: calls.append(b) or True)
+    assert calls == []
+
+
+# -------------------------------------------------- rank-targeted inject
+
+
+def test_inject_rank_targeting():
+    # This process is rank 0: a rank-1 plan never fires here...
+    inject.activate(inject.FaultPlan(rank=1, step_exception_at=0))
+    inject.fire("step", epoch=0, step=0)
+    # ...a rank-0 plan does.
+    inject.activate(inject.FaultPlan(rank=0, step_exception_at=0))
+    with pytest.raises(RuntimeError, match="injected step exception"):
+        inject.fire("step", epoch=0, step=0)
+
+
+def test_inject_hide_latest_durable_transform():
+    inject.activate(inject.FaultPlan(hide_latest_durable=True))
+    assert inject.transform("durable_candidates", [2, 4, 8]) == [2, 4]
+    # Fires once: the retry sees the true candidate list.
+    assert inject.transform("durable_candidates", [2, 4, 8]) == [2, 4, 8]
+    inject.activate(inject.FaultPlan(rank=1, hide_latest_durable=True))
+    assert inject.transform("durable_candidates", [2, 4]) == [2, 4]  # rank 0
+    inject.deactivate()
+    assert inject.transform("durable_candidates", [2, 4]) == [2, 4]
+
+
+def test_fault_plan_env_accepts_new_fields(monkeypatch):
+    monkeypatch.setenv("DDT_FAULT_PLAN",
+                       '{"rank": 1, "sigterm_after_seed_scores": 2}')
+    plan = inject.activate_from_env()
+    assert plan.rank == 1 and plan.sigterm_after_seed_scores == 2
+
+
+# ------------------------------------------- checkpoint agreement surface
+
+
+def test_verified_steps_and_restore_checked(tiny_cfg, tiny_ds, mesh8,
+                                            tmp_path):
+    from data_diet_distributed_tpu.checkpoint import CheckpointManager
+    from data_diet_distributed_tpu.resilience.integrity import \
+        CheckpointCorrupt
+    from data_diet_distributed_tpu.train import loop as loop_mod
+
+    train_ds, _ = tiny_ds
+    ckdir = f"{tmp_path}/ckpt"
+    tiny_cfg.train.checkpoint_every = 1
+    loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8, num_epochs=2,
+                 checkpoint_dir=ckdir)
+    mngr = CheckpointManager(ckdir)
+    try:
+        assert mngr.verified_steps() == [4, 8]
+        assert mngr.verified_steps(max_step=4) == [4]
+        template = loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8,
+                                num_epochs=0).state
+        restored = mngr.restore_checked(template, 8)
+        assert int(restored.step) == 8
+        # Truncate step 8's payload: the manifest (metadata) still lists it
+        # as a candidate, but the exact-step restore refuses — no silent
+        # per-rank fallback on the consensus path.
+        inject.truncate_checkpoint(ckdir, 8)
+        with pytest.raises((CheckpointCorrupt, Exception)):
+            mngr.restore_checked(template, 8)
+    finally:
+        mngr.close()
+
+
+def test_fit_consensus_restore_uses_agreed_step(tiny_cfg, tiny_ds, mesh8,
+                                                tmp_path, monkeypatch):
+    """Single-process probe of the consensus restore branch in ``fit``: with
+    a Consensus attached, restore goes through verified_steps ->
+    durable_candidates injection -> agree_restore_step; hiding the latest
+    durable step resumes from the earlier one."""
+    from data_diet_distributed_tpu.train import loop as loop_mod
+
+    train_ds, _ = tiny_ds
+    ckdir = f"{tmp_path}/ckpt"
+    tiny_cfg.train.checkpoint_every = 1
+    loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8, num_epochs=2,
+                 checkpoint_dir=ckdir)
+
+    made = {}
+
+    def fake_create(cls_cfg, **kw):
+        made["c"] = Consensus(str(tmp_path / "chan"))
+        return made["c"]
+
+    monkeypatch.setattr(loop_mod.Consensus, "create",
+                        classmethod(lambda cls, cfg, **kw: fake_create(cfg)))
+    inject.activate(inject.FaultPlan(hide_latest_durable=True))
+    tiny_cfg.train.resume = True
+    res = loop_mod.fit(tiny_cfg, train_ds, None, mesh=mesh8, num_epochs=2,
+                       checkpoint_dir=ckdir)
+    # Hidden latest (8) -> agreed 4 -> exactly epoch 1 re-ran.
+    assert [r["epoch"] for r in res.history] == [1]
+    assert int(res.state.step) == 8

@@ -1,0 +1,187 @@
+"""Real 2-process consensus drills (slow lane): every agreement path in
+``resilience/consensus.py`` pinned under the actual ``jax.distributed``
+runtime with RANK-TARGETED fault injection.
+
+Reuses the ``multihost_worker.py`` subprocess harness (two processes x 4
+virtual CPU devices, one 8-device mesh). The claims under test are exactly
+the ISSUE's acceptance criteria:
+
+* a rank-1-only SIGTERM makes BOTH ranks write the same final checkpoint
+  step and exit 75, with no hang (bounded wall-clock), and re-invocation
+  resumes from that agreed step;
+* a rank-1-only NaN loss raises ``DivergenceError`` on both ranks in
+  lockstep at the same epoch;
+* a rank-1-only hang poisons the side-channel so rank 0 aborts (retriable)
+  instead of wedging in a dead collective — both ranks exit within a bound
+  that is a small multiple of the watchdog deadline, not the 600 s hang;
+* when rank 1's latest durable checkpoint is missing, BOTH ranks restore
+  the min-agreed earlier step.
+
+Marked ``slow``: each drill pays two interpreter starts + distributed init.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+EXIT_PREEMPTED = 75
+EXIT_RETRIABLE = 69
+EXIT_DIVERGED = 13
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# Failure signatures of the ENVIRONMENT, not the code under test: on this
+# oversubscribed 1-core box a worker occasionally stalls >100 s in compile,
+# so its peer's coordination-service heartbeat declares it dead (SIGABRT),
+# or gloo's TCP pair aborts mid-frame under load. One retry, gated on these
+# exact signatures — an assertion-class failure never retries.
+_INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "Shutdown barrier has failed")
+
+
+def _infra_crash(scenario_outs, rcs) -> bool:
+    return any(rc == -6 or any(sig in out for sig in _INFRA_CRASH_SIGNATURES)
+               for rc, out in zip(rcs, scenario_outs))
+
+
+def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=True):
+    """Run the 2-process harness in ``scenario`` mode; returns
+    (returncodes, results-by-pid (None when a rank died before writing),
+    wall seconds). Retries ONCE on the environmental crash signatures
+    above."""
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", coordinator, str(out_dir),
+             "1", scenario],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    wall = time.monotonic() - t0
+    rcs = [p.returncode for p in procs]
+    if _retry and _infra_crash(outs, rcs):
+        print(f"--- {scenario}: environmental crash (rcs={rcs}); one retry")
+        for pid in range(2):   # a half-written pair must not satisfy asserts
+            try:
+                os.remove(os.path.join(str(out_dir), f"result_{pid}.json"))
+            except FileNotFoundError:
+                pass
+        return _launch(out_dir, scenario, timeout_s, _retry=False)
+    results = []
+    for pid in range(2):
+        path = os.path.join(str(out_dir), f"result_{pid}.json")
+        try:
+            with open(path) as fh:
+                results.append(json.load(fh))
+        except FileNotFoundError:
+            results.append(None)   # escalated os._exit before writing
+    for p, out, r in zip(procs, outs, results):
+        assert p.returncode is not None, out[-2000:]
+        if r is None:
+            print(f"--- worker without result json (rc={p.returncode}):\n"
+                  f"{out[-2000:]}")
+    return rcs, results, wall
+
+
+def test_rank1_sigterm_preempts_both_ranks_and_resumes(tmp_path):
+    """ISSUE acceptance: rank-1-only SIGTERM -> same final checkpoint step on
+    both ranks, both exit 75, no hang; re-invocation resumes from it."""
+    rcs, results, wall = _launch(tmp_path, "sigterm_rank1", timeout_s=420)
+    assert wall < 420
+    assert rcs == [EXIT_PREEMPTED, EXIT_PREEMPTED], (rcs, results)
+    for r in results:
+        assert r is not None and r["outcome"] == "preempted", results
+    # Same durable step everywhere — the OR-reduced flag fired the preempt
+    # exit on the same step, and the final save was one multi-host Orbax
+    # checkpoint (epoch 0 end -> step 4 at 256/64 examples per batch).
+    assert results[0]["durable_step"] == results[1]["durable_step"] == 4
+    assert results[0]["step"] == results[1]["step"]
+
+    rcs, results, _ = _launch(tmp_path, "resume_after_preempt", timeout_s=420)
+    assert rcs == [0, 0], (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed"
+        # Resumed from the agreed step-4 checkpoint: epochs 1..2 remain of 3.
+        assert r["epochs_run"] == [1, 2]
+        assert r["final_step"] == 12
+
+
+def test_rank1_nan_raises_divergence_on_both_ranks(tmp_path):
+    """The finiteness verdict is OR-reduced: a rank-1-only (host-side
+    injected) NaN loss fails BOTH ranks at the same epoch — rank 0's error
+    carries the remote=True provenance."""
+    rcs, results, wall = _launch(tmp_path, "nan_rank1", timeout_s=420)
+    assert wall < 420
+    assert rcs == [EXIT_DIVERGED, EXIT_DIVERGED], (rcs, results)
+    by_pid = {r["pid"]: r for r in results if r is not None}
+    assert by_pid[0]["outcome"] == by_pid[1]["outcome"] == "divergence"
+    assert by_pid[0]["epoch"] == by_pid[1]["epoch"] == 1
+    assert by_pid[0]["remote"] is True    # rank 0's own loss was finite
+    assert by_pid[1]["remote"] is False   # rank 1 held the injected NaN
+
+
+def test_rank1_hang_poisons_so_rank0_aborts_bounded(tmp_path):
+    """A rank-1 hang fires rank 1's watchdog, which poisons the side-channel;
+    rank 0 must abort retriably (PeerPoisoned / its own watchdog escalation /
+    a collective teardown error) — NOT hang for the injected 600 s."""
+    rcs, results, wall = _launch(tmp_path, "hang_rank1", timeout_s=300)
+    assert wall < 300   # vs the 600 s injected hang
+    by_pid = {r["pid"]: r for r in results if r is not None}
+    # Rank 1: the interruptible injected sleep -> WatchdogTimeout -> 69.
+    assert rcs[1] == EXIT_RETRIABLE, (rcs, results)
+    assert by_pid[1]["outcome"] == "aborted"
+    assert "WatchdogTimeout" in by_pid[1]["error"]
+    # Rank 0 exits retriably-or-fatally but BOUNDED: PeerPoisoned caught in
+    # the step loop (69), watchdog escalation out of a wedged collective
+    # (os._exit 69, result json may be absent), or the distributed runtime
+    # tearing down the collective when its peer died (recorded error).
+    assert rcs[0] != 0, (rcs, results)
+    if rcs[0] == EXIT_RETRIABLE and by_pid.get(0) is not None:
+        assert by_pid[0]["outcome"] == "aborted"
+
+
+def test_divergent_latest_checkpoint_restores_min_agreed(tmp_path):
+    """Restore consensus: with rank 1's newest durable step hidden (its
+    'final save never landed'), BOTH ranks must restore the min-agreed step 4
+    and re-run epoch 1 — not rank 0's local latest (step 8)."""
+    rcs, results, _ = _launch(tmp_path, "divergent_restore_seed",
+                              timeout_s=420)
+    assert rcs == [0, 0], (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed" and r["final_step"] == 8
+
+    rcs, results, _ = _launch(tmp_path, "divergent_restore_resume",
+                              timeout_s=420)
+    assert rcs == [0, 0], (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed"
+        # Restored the agreed step 4 (end of epoch 0) on BOTH ranks: exactly
+        # epoch 1 re-runs. A rank trusting its local latest (8) would have
+        # run nothing — and desynced the other rank's collectives.
+        assert r["epochs_run"] == [1]
+        assert r["final_step"] == 8

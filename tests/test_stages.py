@@ -1,0 +1,318 @@
+"""Stage-resumable pipeline: preemption injected at each stage boundary of
+``run`` must lose at most the in-flight unit, and re-invocation must skip
+completed stages and reproduce an uninterrupted run bit-for-bit where
+determinism allows (same seeds -> same scores -> same kept set -> same
+retrain trajectory)."""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.resilience.preemption import Preempted
+from data_diet_distributed_tpu.resilience.stages import (ScorePartialStore,
+                                                         StageManifest)
+from data_diet_distributed_tpu.train.loop import (load_scores_npz,
+                                                  pipeline_fingerprint,
+                                                  run_datadiet, run_sweep)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    inject.deactivate()
+
+
+def _mk_cfg(tmp_path, *extra):
+    os.makedirs(tmp_path, exist_ok=True)   # sibling "base" dirs of tmp_path
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0", "score.seeds=[0,1,2,3]",
+        "score.batch_size=64", "prune.sparsity=0.5", *extra])
+
+
+def _events(cfg, kind):
+    with open(cfg.obs.metrics_path) as fh:
+        return [e for e in (json.loads(line) for line in fh if line.strip())
+                if e["kind"] == kind]
+
+
+@pytest.fixture(scope="module")
+def uninterrupted(tmp_path_factory):
+    """One full baseline run: summary + the scores artifact to pin against."""
+    tmp = tmp_path_factory.mktemp("stage_base")
+    cfg = _mk_cfg(tmp)
+    summary = run_datadiet(cfg)
+    art = dict(np.load(f"{tmp}/ckpt_scores.npz"))
+    return cfg, summary, art
+
+
+def test_preempt_mid_scoring_loses_at_most_one_seed(tmp_path, uninterrupted):
+    """ISSUE acceptance: kill `run` mid-scoring with 4 seeds -> per-seed
+    partials keep the completed passes; re-invocation recomputes only the
+    incomplete seeds and the final artifacts are bit-identical."""
+    _, base_summary, base_art = uninterrupted
+    cfg = _mk_cfg(tmp_path)
+    inject.activate(inject.FaultPlan(sigterm_after_seed_scores=2))
+    with pytest.raises(Preempted):
+        run_datadiet(cfg)
+    inject.deactivate()
+    # Exactly the two completed seeds' partials are durable.
+    assert sorted(os.listdir(f"{tmp_path}/ckpt_score_partials")) == \
+        ["seed0.npz", "seed1.npz"]
+
+    summary = run_datadiet(_mk_cfg(tmp_path))
+    resumed = _events(cfg, "score_seeds_resumed")
+    assert resumed and resumed[-1]["done"] == [0, 1]
+    assert resumed[-1]["todo"] == [2, 3]
+    art = dict(np.load(f"{tmp_path}/ckpt_scores.npz"))
+    # float64 per-seed partials -> the resumed mean is BIT-identical.
+    np.testing.assert_array_equal(art["scores"], base_art["scores"])
+    np.testing.assert_array_equal(np.sort(art["kept"]),
+                                  np.sort(base_art["kept"]))
+    assert summary["n_kept"] == base_summary["n_kept"]
+    assert summary["final_test_accuracy"] == base_summary["final_test_accuracy"]
+
+
+def test_preempt_mid_retrain_resumes_from_checkpoint(tmp_path, uninterrupted):
+    """Preemption inside the retrain fit: the stage manifest records the
+    started stage, scoring is never redone, and re-invocation resumes the
+    retrain from its own durable checkpoint (pinned to uninterrupted)."""
+    _, base_summary, base_art = uninterrupted
+    cfg = _mk_cfg(tmp_path, "train.num_epochs=2")
+    # pretrain_epochs=0: the ONLY fit in the pipeline is the retrain, so the
+    # epoch-end SIGTERM coordinate can't land in a scoring pretrain.
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    with pytest.raises(Preempted) as exc_info:
+        run_datadiet(cfg)
+    inject.deactivate()
+    assert exc_info.value.durable_step == 2   # 128 kept / 64 per batch
+    assert _events(cfg, "stage")[-1]["stage"] == "retrain:final"
+
+    base2 = run_datadiet(_mk_cfg(tmp_path.parent / f"{tmp_path.name}_base",
+                                 "train.num_epochs=2"))
+    summary = run_datadiet(_mk_cfg(tmp_path, "train.num_epochs=2"))
+    # Scoring fully resumed from partials; retrain resumed mid-stage.
+    assert _events(cfg, "score_seeds_resumed")[-1]["todo"] == []
+    stage_ev = _events(cfg, "stage")
+    assert any(e["status"] == "resuming" and e["stage"] == "retrain:final"
+               for e in stage_ev)
+    resumes = _events(cfg, "resume")
+    assert resumes and resumes[-1]["step"] == 2 and resumes[-1]["epoch"] == 1
+    assert summary["final_test_accuracy"] == base2["final_test_accuracy"]
+    np.testing.assert_array_equal(
+        np.load(f"{tmp_path}/ckpt_scores.npz")["scores"], base_art["scores"])
+
+
+def test_completed_run_skips_and_returns_recorded_summary(tmp_path):
+    cfg = _mk_cfg(tmp_path, "score.seeds=[0]")
+    s1 = run_datadiet(cfg)
+    s2 = run_datadiet(_mk_cfg(tmp_path, "score.seeds=[0]"))
+    assert s2["final_test_accuracy"] == s1["final_test_accuracy"]
+    assert s2["n_kept"] == s1["n_kept"]
+    skipped = [e for e in _events(cfg, "stage") if e["status"] == "skipped"]
+    assert skipped and skipped[-1]["stage"] == "retrain:final"
+
+
+def test_changed_config_invalidates_stage_manifest(tmp_path):
+    """A different sparsity must NOT reuse the recorded retrain — the
+    fingerprint invalidates the manifest (scores partials, being
+    sparsity-independent, still resume)."""
+    run_datadiet(_mk_cfg(tmp_path, "score.seeds=[0]"))
+    cfg2 = _mk_cfg(tmp_path, "score.seeds=[0]", "prune.sparsity=0.25")
+    s2 = run_datadiet(cfg2)
+    assert s2["n_kept"] == 192   # actually retrained at the new sparsity
+    resets = [e for e in _events(cfg2, "stage") if e["status"] == "reset"]
+    assert resets and resets[-1]["reason"] == "config fingerprint changed"
+    # Sparsity does not change scores: the seed-0 partial WAS reused.
+    assert _events(cfg2, "score_seeds_resumed")[-1]["done"] == [0]
+
+
+def test_changed_score_recipe_invalidates_partials(tmp_path):
+    """A SCORE-relevant config change (pretrain LR here) must recompute the
+    per-seed partials, not silently average stale ones into the new run."""
+    run_datadiet(_mk_cfg(tmp_path, "score.seeds=[0]",
+                         "score.pretrain_epochs=1"))
+    cfg2 = _mk_cfg(tmp_path, "score.seeds=[0]", "score.pretrain_epochs=1",
+                   "optim.lr=0.05")
+    run_datadiet(cfg2)
+    invalid = [e for e in _events(cfg2, "stage") if e["status"] == "invalid"]
+    assert invalid and "fingerprint" in invalid[0]["error"]
+    assert not [e for e in _events(cfg2, "score_seeds_resumed")
+                if e["done"]]   # nothing stale was reused
+
+
+def test_sweep_interrupted_at_level_resumes_remaining(tmp_path):
+    """Preempt during the FIRST sweep level's retrain: re-invocation skips
+    nothing it shouldn't, finishes level 1 from its checkpoint, runs level 2,
+    and matches an uninterrupted sweep."""
+    base = run_sweep(_mk_cfg(tmp_path.parent / f"{tmp_path.name}_base",
+                             "prune.sweep=[0.25,0.5]", "train.num_epochs=2",
+                             "score.seeds=[0,1]"))
+    cfg = _mk_cfg(tmp_path, "prune.sweep=[0.25,0.5]", "train.num_epochs=2",
+                  "score.seeds=[0,1]")
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    with pytest.raises(Preempted):
+        run_sweep(cfg)
+    inject.deactivate()
+    summaries = run_sweep(_mk_cfg(tmp_path, "prune.sweep=[0.25,0.5]",
+                                  "train.num_epochs=2", "score.seeds=[0,1]"))
+    assert [s["sparsity"] for s in summaries] == [0.25, 0.5]
+    assert [s["n_kept"] for s in summaries] == [s["n_kept"] for s in base]
+    assert [s["final_test_accuracy"] for s in summaries] == \
+        [s["final_test_accuracy"] for s in base]
+
+
+def test_trajectory_scores_resume_partials(tmp_path):
+    """Forgetting (trajectory) scoring persists per-seed partials too: a
+    SIGTERM at the first seed boundary loses only the in-flight seed."""
+    base_cfg = _mk_cfg(tmp_path.parent / f"{tmp_path.name}_base",
+                       "score.method=forgetting", "score.pretrain_epochs=1",
+                       "score.seeds=[0,1]")
+    base = run_datadiet(base_cfg)
+    cfg = _mk_cfg(tmp_path, "score.method=forgetting",
+                  "score.pretrain_epochs=1", "score.seeds=[0,1]")
+    inject.activate(inject.FaultPlan(sigterm_after_seed_scores=1))
+    with pytest.raises(Preempted):
+        run_datadiet(cfg)
+    inject.deactivate()
+    assert os.listdir(f"{tmp_path}/ckpt_score_partials") == ["seed0.npz"]
+    summary = run_datadiet(_mk_cfg(tmp_path, "score.method=forgetting",
+                                   "score.pretrain_epochs=1",
+                                   "score.seeds=[0,1]"))
+    assert _events(cfg, "score_seeds_resumed")[-1]["done"] == [0]
+    assert summary["n_kept"] == base["n_kept"]
+    np.testing.assert_array_equal(
+        np.load(f"{tmp_path}/ckpt_scores.npz")["scores"],
+        np.load(f"{base_cfg.train.checkpoint_dir}_scores.npz")["scores"])
+
+
+# ------------------------------------------------- npz hardening satellites
+
+
+def test_truncated_scores_npz_detected_not_deserialized(tmp_path, tiny_ds):
+    train_ds, _ = tiny_ds
+    path = str(tmp_path / "scores.npz")
+    np.savez(path, scores=np.arange(256, dtype=np.float32),
+             indices=np.arange(256), method="el2n")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 3)
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        load_scores_npz(path, train_ds)
+    # The error NAMES the path (the ISSUE's "clear error naming the path").
+    with pytest.raises(ValueError, match="scores.npz"):
+        load_scores_npz(path, train_ds)
+
+
+def test_scores_npz_method_mismatch_refuses(tmp_path, tiny_ds):
+    train_ds, _ = tiny_ds
+    path = str(tmp_path / "scores.npz")
+    np.savez(path, scores=np.arange(256, dtype=np.float32),
+             indices=np.arange(256), method="el2n")
+    with pytest.raises(ValueError, match="score.method"):
+        load_scores_npz(path, train_ds, expect_method="grand")
+    # Matching / unrecorded / reused-provenance methods load fine.
+    assert load_scores_npz(path, train_ds, expect_method="el2n").shape == (256,)
+    np.savez(path, scores=np.arange(256, dtype=np.float32),
+             indices=np.arange(256))
+    assert load_scores_npz(path, train_ds, expect_method="grand").shape == (256,)
+    np.savez(path, scores=np.arange(256, dtype=np.float32),
+             indices=np.arange(256), method="reused:/old.npz")
+    assert load_scores_npz(path, train_ds, expect_method="grand").shape == (256,)
+
+
+def test_corrupt_partial_is_recomputed(tmp_path):
+    """A truncated/garbage per-seed partial must be ignored (recomputed), not
+    trusted or fatal."""
+    cfg = _mk_cfg(tmp_path, "score.seeds=[0,1]")
+    pdir = f"{tmp_path}/ckpt_score_partials"
+    os.makedirs(pdir)
+    with open(f"{pdir}/seed0.npz", "wb") as fh:
+        fh.write(b"not a zip at all")
+    summary = run_datadiet(cfg)
+    assert summary["n_kept"] == 128
+    invalid = [e for e in _events(cfg, "stage") if e["status"] == "invalid"]
+    assert invalid and invalid[0]["stage"] == "score_seed:0"
+    # No resumable seeds claimed.
+    assert not _events(cfg, "score_seeds_resumed")
+
+
+# ---------------------------------------------------------- manifest units
+
+
+def test_stage_manifest_atomic_roundtrip_and_reset(tmp_path):
+    path = str(tmp_path / "stages.json")
+    m = StageManifest(path, "fp1")
+    assert not m.completed("x")
+    m.start("x", detail=1)
+    assert m.started("x") and not m.completed("x")
+    m.complete("x", summary={"a": 1})
+    assert m.completed("x")
+    # Reload with same fingerprint: state survives.
+    m2 = StageManifest(path, "fp1")
+    assert m2.completed("x") and m2.info("x")["summary"] == {"a": 1}
+    # Different fingerprint: reset, file not trusted.
+    m3 = StageManifest(path, "fp2")
+    assert not m3.completed("x")
+    # Corrupt file: reset, not fatal.
+    with open(path, "w") as fh:
+        fh.write("{truncated")
+    m4 = StageManifest(path, "fp1")
+    assert not m4.completed("x")
+    # Disabled: fully inert.
+    m5 = StageManifest(path, "fp1", enabled=False)
+    m5.complete("y")
+    assert not m5.completed("y")
+    # No leftover temp files (atomic rename).
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_score_partial_store_validation(tmp_path):
+    idx = np.arange(16)
+    store = ScorePartialStore(str(tmp_path / "p"), method="el2n", indices=idx)
+    arr = np.linspace(0, 1, 16)
+    store.save(3, arr)
+    np.testing.assert_array_equal(store.load(3), arr)
+    assert store.load(3).dtype == np.float64
+    assert store.load(4) is None                       # absent
+    # Wrong method or changed dataset indices refuse (recompute).
+    assert ScorePartialStore(str(tmp_path / "p"), method="grand",
+                             indices=idx).load(3) is None
+    assert ScorePartialStore(str(tmp_path / "p"), method="el2n",
+                             indices=idx + 1).load(3) is None
+    # Non-finite partial (a diverged scoring pass) is not trusted.
+    store.save(5, np.full(16, np.nan))
+    assert store.load(5) is None
+    loaded = store.load_all([3, 4, 5])
+    assert list(loaded) == [3]
+    np.testing.assert_array_equal(loaded[3], arr)
+
+
+def test_pipeline_fingerprint_tracks_compute_relevant_config(tmp_path):
+    cfg = _mk_cfg(tmp_path)
+    fp = pipeline_fingerprint(cfg)
+    assert fp == pipeline_fingerprint(copy.deepcopy(cfg))
+    for mutate in (lambda c: setattr(c.prune, "sparsity", 0.3),
+                   lambda c: setattr(c.score, "method", "grand_last_layer"),
+                   lambda c: setattr(c.score, "seeds", (0, 1)),
+                   lambda c: setattr(c.train, "seed", 7),
+                   lambda c: setattr(c.optim, "lr", 0.2)):
+        c = copy.deepcopy(cfg)
+        mutate(c)
+        assert pipeline_fingerprint(c) != fp
+    # Observability-only knobs do NOT invalidate.
+    c = copy.deepcopy(cfg)
+    c.obs.metrics_path = "/elsewhere.jsonl"
+    c.train.checkpoint_every = 17
+    assert pipeline_fingerprint(c) == fp
