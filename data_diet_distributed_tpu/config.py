@@ -159,6 +159,18 @@ class TrainConfig:
     # host->device traffic becomes one index permutation). None = auto: on for
     # single-process meshes when the dataset fits data/pipeline.RESIDENT_MAX_BYTES.
     device_resident_data: bool | None = None
+    # Chunked execution engine: compile K consecutive train steps (resident
+    # gather included) into ONE dispatch (train/steps.make_train_chunk) — the
+    # per-step dispatch tax (~25 ms on relay-attached hosts) is paid once per
+    # chunk. None = auto: on (train/loop.DEFAULT_CHUNK_STEPS) for
+    # single-process device-resident runs, per-step otherwise (streaming,
+    # multi-host consensus, and step-targeted fault injection always use the
+    # per-step path); 0/1 = force per-step; K>1 = requested size, clamped to
+    # the epoch length and train/loop.MAX_CHUNK_STEPS. Results are
+    # bit-identical either way (pinned by tests/test_chunked.py); resilience
+    # hooks (watchdog beat, preemption poll) run at chunk boundaries, so a
+    # SIGTERM is honored within at most one chunk of steps.
+    chunk_steps: int | None = None
     log_every_steps: int = 50
 
 
@@ -318,6 +330,10 @@ class Config:
             self.model.num_classes = self.data.num_classes
         if self.data.batch_size <= 0 or self.train.num_epochs < 0:
             raise ValueError("batch_size must be positive, num_epochs non-negative")
+        if self.train.chunk_steps is not None and self.train.chunk_steps < 0:
+            raise ValueError(
+                f"train.chunk_steps must be >= 0 (0/1 = per-step, null = "
+                f"auto), got {self.train.chunk_steps}")
         r = self.resilience
         if r.step_timeout_s is not None and r.step_timeout_s <= 0:
             raise ValueError(

@@ -185,6 +185,25 @@ def device_stream(ds: ArrayDataset, batch_size: int, sharder: BatchSharder, *,
 RESIDENT_MAX_BYTES = 2 << 30
 
 
+def gather_resident_batch(images, labels, indices, idx, mask,
+                          out_sharding=None):
+    """THE device-side batch composition — one definition shared by the
+    per-step ``ResidentBatches`` gather and the chunked engine's scan body
+    (``train/steps.make_train_chunk``), so the two paths cannot drift.
+
+    Matches ``BatchAssembler``'s host path exactly: padded tail rows repeat
+    dataset row 0 with ``mask=0`` and zeroed label/index. ``out_sharding``
+    constrains every entry to the data-sharded layout so each device
+    materializes only its own batch shard (no collectives)."""
+    valid = mask.astype(labels.dtype)
+    batch = {"image": images[idx], "label": labels[idx] * valid,
+             "index": indices[idx] * valid, "mask": mask}
+    if out_sharding is not None:
+        batch = {k: jax.lax.with_sharding_constraint(v, out_sharding)
+                 for k, v in batch.items()}
+    return batch
+
+
 class ResidentBatches:
     """Device-resident epoch batching: upload the dataset to HBM ONCE, then every
     epoch is on-device gathers driven by a host-side permutation.
@@ -216,7 +235,9 @@ class ResidentBatches:
         self.n = len(ds)
         self.batch_size = batch_size
         replicated = NamedSharding(mesh, P())
-        out_sharding = NamedSharding(mesh, P(data_axis))
+        # Public: the chunked engine (train/steps.make_train_chunk) compiles
+        # this same layout constraint into its scan body.
+        self.out_sharding = NamedSharding(mesh, P(data_axis))
         self.images = jax.device_put(
             np.asarray(ds.images, dtype=jnp.dtype(image_dtype)), replicated)
         self.labels = jax.device_put(
@@ -224,14 +245,12 @@ class ResidentBatches:
         self.indices = jax.device_put(
             np.ascontiguousarray(ds.indices, np.int32), replicated)
 
+        out_sharding = self.out_sharding
+
         @jax.jit
         def gather(images, labels, indices, idx, mask):
-            valid = mask.astype(labels.dtype)   # zero pad labels/indices like
-            batch = {"image": images[idx],      # BatchAssembler's host path
-                     "label": labels[idx] * valid,
-                     "index": indices[idx] * valid, "mask": mask}
-            return {k: jax.lax.with_sharding_constraint(v, out_sharding)
-                    for k, v in batch.items()}
+            return gather_resident_batch(images, labels, indices, idx, mask,
+                                         out_sharding)
 
         self._gather = gather
 
@@ -251,6 +270,24 @@ class ResidentBatches:
                 take = np.concatenate([take, np.zeros(pad, np.int32)])
             yield self._gather(self.images, self.labels, self.indices,
                                jnp.asarray(take), jnp.asarray(mask))
+
+    def chunk_indices(self, chunk_steps: int, *, shuffle: bool = False,
+                      seed: int = 0, epoch: int = 0):
+        """Yield ``(idx, mask)`` blocks of shape ``[K, batch_size]`` for the
+        chunked engine — the SAME epoch batch composition as ``__call__``
+        (order, row-0 tail padding, mask), just stacked ``chunk_steps`` steps
+        at a time. The epoch's last block carries the remainder (a second
+        compiled chunk length, never a padded dispatch that would run extra
+        optimizer updates)."""
+        order = (epoch_permutation(self.n, seed, epoch) if shuffle
+                 else np.arange(self.n)).astype(np.int32)
+        nb = num_batches(self.n, self.batch_size)
+        idx = np.zeros((nb, self.batch_size), np.int32)
+        mask = np.zeros((nb, self.batch_size), np.float32)
+        idx.reshape(-1)[:self.n] = order
+        mask.reshape(-1)[:self.n] = 1.0
+        for start in range(0, nb, chunk_steps):
+            yield idx[start:start + chunk_steps], mask[start:start + chunk_steps]
 
 
 def maybe_resident(ds: ArrayDataset, mesh: Mesh, batch_size: int,

@@ -47,7 +47,58 @@ from ..resilience.stages import (ScorePartialStore, StageManifest,
 from ..resilience.watchdog import Watchdog, WatchdogTimeout
 from ..utils.io import atomic_savez
 from .state import TrainState, create_train_state
-from .steps import make_eval_step, make_train_step
+from .steps import (make_eval_chunk, make_eval_step, make_train_chunk,
+                    make_train_step)
+
+#: Auto chunk size for the chunked execution engine (K train steps per
+#: dispatch). Sized from the measured per-dispatch overhead on this repo's
+#: relay-attached hosts (~25 ms/dispatch, tools/profile_dispatch.py) against
+#: a ResNet-18 b1024 step (~34 ms): K=16 amortizes the dispatch tax to ~5 %
+#: of compute. Chunks are fully unrolled for bit-exactness (train/steps.py),
+#: so the default also bounds compile size.
+DEFAULT_CHUNK_STEPS = 16
+
+#: Hard clamp on train.chunk_steps: one chunk is the preemption/watchdog
+#: response granularity (signals are honored at chunk boundaries), and the
+#: unrolled program grows linearly with K — both argue for a bound.
+MAX_CHUNK_STEPS = 64
+
+
+def _step_targeted_injection() -> bool:
+    """An armed fault plan with an exact-step coordinate (step exception,
+    hang, mid-epoch SIGTERM) needs the per-step loop to fire at that exact
+    step — the chunked engine only visits chunk boundaries."""
+    plan = inject.active_plan()
+    return plan is not None and any(
+        getattr(plan, f) is not None
+        for f in ("step_exception_at", "hang_at", "sigterm_at_step"))
+
+
+def resolve_chunk_steps(cfg: Config, steps_per_epoch: int, train_resident,
+                        consensus) -> int:
+    """The chunked-engine selection policy — returns the chunk size (1 = the
+    per-step path).
+
+    ``train.chunk_steps``: None = auto (chunking on for single-process
+    device-resident runs), 0/1 = forced per-step, K>1 = requested chunk size.
+    Fallbacks to per-step, even when requested: streaming input (the gather
+    the chunk scans over is the RESIDENT gather; ``ResidentBatches`` is also
+    what guarantees single-process), multi-host consensus (its per-step
+    preemption OR-reduce and peer-poison polls are collectives every rank
+    must reach at the same step), and an armed step-targeted fault injection
+    (exact-step coordinates need the per-step loop). The result is clamped to
+    the epoch length (a chunk never crosses an epoch boundary — epoch
+    semantics, eval cadence and checkpointing are unchanged) and to
+    ``MAX_CHUNK_STEPS`` (preemption latency + unrolled program size)."""
+    k = cfg.train.chunk_steps
+    if k is not None and k <= 1:
+        return 1
+    if (train_resident is None or consensus is not None
+            or _step_targeted_injection()):
+        return 1
+    if k is None:
+        k = DEFAULT_CHUNK_STEPS
+    return max(1, min(int(k), steps_per_epoch, MAX_CHUNK_STEPS))
 
 
 @dataclass
@@ -55,6 +106,7 @@ class FitResult:
     state: TrainState
     history: list[dict[str, Any]] = field(default_factory=list)
     wall_s: float = 0.0
+    chunk_steps: int = 1   # the engine fit actually ran (1 = per-step)
 
     @property
     def final_test_accuracy(self) -> float | None:
@@ -90,8 +142,8 @@ def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Confi
 
 
 def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
-             batch_size: int, eval_step=None, resident=None) -> dict[str, float]:
-    eval_step = eval_step or make_eval_step(model)
+             batch_size: int, eval_step=None, resident=None,
+             chunk_steps: int = 1) -> dict[str, float]:
     batch_size = sharder.global_batch_size_for(batch_size)
     if resident is not None and resident.batch_size != batch_size:
         raise ValueError(
@@ -99,23 +151,37 @@ def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
             f"{resident.batch_size} but batch_size={batch_size} was requested; "
             "rebuild the ResidentBatches or pass the matching size")
     totals = {"loss_sum": 0.0, "correct": 0.0, "examples": 0.0}
-    batches = (resident() if resident is not None else
-               (db for _, db in device_stream(ds, batch_size, sharder)))
-    # Dispatch ahead, fetch in bounded windows: one host round trip per window
-    # (per-scalar float() syncs are ruinous on high-latency device transports)
-    # without pinning every streamed batch in HBM at once (resident batches live
-    # on device anyway — no window needed there).
-    window = 1 << 30 if resident is not None else 8
+    if resident is not None and chunk_steps > 1:
+        # Chunked eval: K batches per dispatch over the resident arrays (the
+        # gather runs inside the chunk); the flush below unstacks the [K]
+        # sums and accumulates batch-by-batch in the per-dispatch order, so
+        # the reported metrics are bit-identical to the per-batch path.
+        chunk_fn = make_eval_chunk(model, resident.out_sharding)
+        outs = (chunk_fn(state, resident.images, resident.labels,
+                         resident.indices, jnp.asarray(idx), jnp.asarray(m))
+                for idx, m in resident.chunk_indices(chunk_steps))
+        window = 1 << 30
+    else:
+        eval_step = eval_step or make_eval_step(model)
+        batches = (resident() if resident is not None else
+                   (db for _, db in device_stream(ds, batch_size, sharder)))
+        outs = (eval_step(state, b) for b in batches)
+        # Dispatch ahead, fetch in bounded windows: one host round trip per
+        # window (per-scalar float() syncs are ruinous on high-latency device
+        # transports) without pinning every streamed batch in HBM at once
+        # (resident batches live on device anyway — no window needed there).
+        window = 1 << 30 if resident is not None else 8
     pending: list[dict] = []
 
     def flush():
-        for m in jax.device_get(pending):
+        for m in _flatten_step_metrics(jax.device_get(pending),
+                                       key="examples"):
             for k in totals:
                 totals[k] += float(m[k])
         pending.clear()
 
-    for b in batches:
-        pending.append(eval_step(state, b))
+    for o in outs:
+        pending.append(o)
         if len(pending) >= window:
             flush()
     flush()
@@ -246,6 +312,17 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                 sharder.global_batch_size_for(cfg.data.eval_batch_size),
                 _image_dtype(cfg), enabled=cfg.train.device_resident_data)
 
+        # Chunked execution engine: K steps per dispatch when the run is
+        # single-process and device-resident (resolve_chunk_steps documents
+        # the fallbacks). Resolved HERE — after residents exist, before the
+        # watchdog — because the chunk size scales the heartbeat deadline.
+        chunk_steps = resolve_chunk_steps(cfg, steps_per_epoch,
+                                          train_resident, consensus)
+        result.chunk_steps = chunk_steps
+        if chunk_steps > 1:
+            logger.log("train_chunked", tag=tag, chunk_steps=chunk_steps,
+                       steps_per_epoch=steps_per_epoch)
+
         # Resilience envelope (resilience/): SIGTERM/SIGINT flip a polled flag
         # (final synchronous checkpoint + Preempted), a missed per-step
         # heartbeat raises a retriable WatchdogTimeout instead of hanging, and
@@ -254,11 +331,16 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         # the poison-side-channel agent: firing broadcasts poison, the
         # monitor polls for peer poison, and a rank wedged in a dead
         # collective exits retriably after the grace instead of hanging.
-        watchdog = (Watchdog(cfg.resilience.step_timeout_s,
+        # Chunked: one heartbeat per CHUNK, so the deadline must cover K
+        # steps of legitimate progress — scaled by the chunk size.
+        wd_timeout = cfg.resilience.step_timeout_s
+        if wd_timeout is not None and chunk_steps > 1:
+            wd_timeout *= chunk_steps
+        watchdog = (Watchdog(wd_timeout,
                              label=f"{tag} step loop",
                              **(consensus.watchdog_kwargs()
                                 if consensus is not None else {}))
-                    if cfg.resilience.step_timeout_s else None)
+                    if wd_timeout else None)
         preempt = PreemptionHandler(enabled=cfg.resilience.preemption)
         sentinel = LossSentinel(enabled=cfg.resilience.nan_check)
         with preempt, (watchdog or contextlib.nullcontext()):
@@ -267,7 +349,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         batch_size, tag, result, saved_steps, train_resident,
                         test_resident, steps_per_epoch, epoch_hook,
                         watchdog=watchdog, preempt=preempt, sentinel=sentinel,
-                        consensus=consensus)
+                        consensus=consensus, chunk_steps=chunk_steps,
+                        augment=augment)
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -320,50 +403,132 @@ def _preempt_due(preempt, consensus, unit=None) -> bool:
     return local
 
 
+def _dispatch_chunk(chunk_fn, state, resident, idx, mask):
+    """One chunked dispatch: K steps, one host round trip to enqueue. A
+    module-level seam so tests can interpose at chunk boundaries (e.g. a
+    SIGTERM landing mid-run must be honored within one chunk)."""
+    return chunk_fn(state, resident.images, resident.labels, resident.indices,
+                    jnp.asarray(idx), jnp.asarray(mask))
+
+
+def _flatten_step_metrics(fetched: list[dict],
+                          key: str = "examples") -> list[dict]:
+    """Fetched step metrics in per-step order: per-chunk entries hold ``[K]``
+    arrays (``key`` names one, present in train and eval dicts alike) and are
+    unstacked, per-step entries pass through — so the epoch record and the
+    eval totals sum the same scalars in the same order under either engine
+    (bit-identical results is the chunked engine's contract)."""
+    flat: list[dict] = []
+    for m in fetched:
+        if np.ndim(m[key]):
+            flat.extend({k: v[j] for k, v in m.items()}
+                        for j in range(len(m[key])))
+        else:
+            flat.append(m)
+    return flat
+
+
 def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 sharder, logger, ckpt, start_epoch, batch_size, tag, result,
                 saved_steps=None, train_resident=None, test_resident=None,
                 steps_per_epoch=None, epoch_hook=None, watchdog=None,
-                preempt=None, sentinel=None, consensus=None):
+                preempt=None, sentinel=None, consensus=None, chunk_steps=1,
+                augment=None):
+    chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding)
+                if chunk_steps > 1 else None)
+    # Host-side optimizer-step accounting for log events (fetching state.step
+    # per log would block the pipeline). The offset is nonzero only after
+    # resuming a MID-EPOCH preemption checkpoint, where the replayed epoch's
+    # unit indices lag the restored step counter; state is materialized here
+    # (fresh or just restored), so this one fetch costs nothing.
+    step_offset = int(state.step) - start_epoch * steps_per_epoch
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
         shuffle = cfg.data.shuffle_each_epoch
-        batches = (train_resident(shuffle=shuffle, seed=cfg.train.seed,
-                                  epoch=epoch)
-                   if train_resident is not None else
-                   (db for _, db in device_stream(
-                       train_ds, batch_size, sharder, shuffle=shuffle,
-                       seed=cfg.train.seed, epoch=epoch)))
         # Device scalars accumulate un-synced (async dispatch); host conversion
         # happens once per epoch below, in a single device_get — per-scalar
         # float() syncs would serialize the epoch on transport latency.
         step_metrics: list[dict] = []
-        for i, batch in enumerate(batches):
-            if watchdog is not None:
-                watchdog.beat()
-            unit = epoch * steps_per_epoch + i
-            if consensus is not None:
-                # A peer's poison (its watchdog fired) aborts THIS rank here,
-                # before it enters a collective the poisoned peer will never
-                # join — PeerPoisoned instead of an unbounded hang.
-                consensus.check_peers(unit)
-            inject.fire("step", epoch=epoch, step=unit)
-            state, metrics = train_step(state, batch)
-            step_metrics.append(metrics)
-            # Streaming mode: bound dispatch runahead so queued host-uploaded
-            # batches can't pile up in HBM (resident batches live there anyway).
-            # Sync on the step ~8 back, not the newest — a sliding window keeps
-            # the pipeline full instead of draining it every 8 steps.
-            if train_resident is None and i >= 8:
-                jax.device_get(step_metrics[i - 8]["examples"])
-            if (i + 1) % cfg.train.log_every_steps == 0:
-                logger.log("train_step", tag=tag, epoch=epoch, step=int(state.step),
-                           loss=float(metrics["loss"]))
-            if _preempt_due(preempt, consensus, unit):
-                result.state = state
-                _preempt_exit(preempt, ckpt, state, logger, tag, epoch - 1,
-                              steps_per_epoch, saved_steps, watchdog=watchdog)
-        step_metrics = jax.device_get(step_metrics)
+        if chunk_steps > 1:
+            # Chunked engine: the epoch is ceil(steps_per_epoch / K)
+            # dispatches, each scanning K (gather + train step)s on device.
+            # Host work per chunk: one [K, B] permutation upload, one
+            # heartbeat, one preemption poll — every per-step hook hoists to
+            # the chunk boundary (resolve_chunk_steps already routed
+            # consensus and step-targeted injection to the per-step path).
+            done = 0
+            for idx, mask in train_resident.chunk_indices(
+                    chunk_steps, shuffle=shuffle, seed=cfg.train.seed,
+                    epoch=epoch):
+                if watchdog is not None:
+                    watchdog.beat()
+                unit = epoch * steps_per_epoch + done
+                inject.fire("step", epoch=epoch, step=unit)
+                state, metrics = _dispatch_chunk(chunk_fn, state,
+                                                 train_resident, idx, mask)
+                step_metrics.append(metrics)
+                prev_done, done = done, done + idx.shape[0]
+                if (done // cfg.train.log_every_steps
+                        > prev_done // cfg.train.log_every_steps):
+                    # The log_every_steps hook, hoisted like the rest: a
+                    # liveness event at the first chunk boundary past each
+                    # logging multiple — host arithmetic only, loss defers to
+                    # the epoch record (as in the resident per-step branch).
+                    logger.log("train_step", tag=tag, epoch=epoch,
+                               step=step_offset + epoch * steps_per_epoch
+                               + done)
+                if _preempt_due(preempt, consensus, unit):
+                    result.state = state
+                    _preempt_exit(preempt, ckpt, state, logger, tag,
+                                  epoch - 1, steps_per_epoch, saved_steps,
+                                  watchdog=watchdog)
+        else:
+            batches = (train_resident(shuffle=shuffle, seed=cfg.train.seed,
+                                      epoch=epoch)
+                       if train_resident is not None else
+                       (db for _, db in device_stream(
+                           train_ds, batch_size, sharder, shuffle=shuffle,
+                           seed=cfg.train.seed, epoch=epoch)))
+            for i, batch in enumerate(batches):
+                if watchdog is not None:
+                    watchdog.beat()
+                unit = epoch * steps_per_epoch + i
+                if consensus is not None:
+                    # A peer's poison (its watchdog fired) aborts THIS rank
+                    # here, before it enters a collective the poisoned peer
+                    # will never join — PeerPoisoned, not an unbounded hang.
+                    consensus.check_peers(unit)
+                inject.fire("step", epoch=epoch, step=unit)
+                state, metrics = train_step(state, batch)
+                step_metrics.append(metrics)
+                # Streaming mode: bound dispatch runahead so queued
+                # host-uploaded batches can't pile up in HBM (resident batches
+                # live there anyway). Sync on the step ~8 back, not the newest
+                # — a sliding window keeps the pipeline full instead of
+                # draining it every 8 steps. The whole dict is fetched (three
+                # scalars, still one round trip) so the periodic train_step
+                # log below reads from host memory, never from the device.
+                if train_resident is None and i >= 8:
+                    step_metrics[i - 8] = jax.device_get(step_metrics[i - 8])
+                if (i + 1) % cfg.train.log_every_steps == 0:
+                    # Log ONLY already-on-host data: float(metrics["loss"]) /
+                    # int(state.step) here would block on the just-dispatched
+                    # step and serialize the pipeline this loop is built to
+                    # keep full. The step index is host arithmetic; the loss
+                    # is the sliding window's lagged fetch when one exists
+                    # (streaming), else deferred to the epoch record.
+                    rec = {"tag": tag, "epoch": epoch,
+                           "step": step_offset + unit + 1}
+                    if train_resident is None and i >= 8:
+                        rec.update(loss=float(step_metrics[i - 8]["loss"]),
+                                   loss_step_lag=8)
+                    logger.log("train_step", **rec)
+                if _preempt_due(preempt, consensus, unit):
+                    result.state = state
+                    _preempt_exit(preempt, ckpt, state, logger, tag, epoch - 1,
+                                  steps_per_epoch, saved_steps,
+                                  watchdog=watchdog)
+        step_metrics = _flatten_step_metrics(jax.device_get(step_metrics))
         if watchdog is not None:
             watchdog.beat()   # the epoch fetch/eval/checkpoint are progress too
         epoch_s = time.perf_counter() - epoch_t0
@@ -398,7 +563,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         if test_ds is not None and ((epoch + 1) % cfg.train.eval_every == 0
                                     or epoch + 1 == cfg.train.num_epochs):
             ev = evaluate(model, state, test_ds, sharder, cfg.data.eval_batch_size,
-                          eval_step, resident=test_resident)
+                          eval_step, resident=test_resident,
+                          chunk_steps=chunk_steps)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
             if watchdog is not None:

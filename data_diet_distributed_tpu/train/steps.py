@@ -1,4 +1,4 @@
-"""Jitted train/eval steps.
+"""Jitted train/eval steps, per-dispatch and chunked (K steps per dispatch).
 
 The reference's hot loop (``trainer/trainer.py:13-35``: zero_grad / forward / CE /
 backward / step, one Python iteration per batch with H2D copies) becomes a single
@@ -16,6 +16,23 @@ compiled XLA program per step:
   (no all-reduce, ``ddp.py:96-107``; SURVEY §2.4.5);
 * the input state is donated — parameters are updated in place in HBM, halving peak
   optimizer memory versus copy-on-update.
+
+The CHUNKED engine (``make_train_chunk`` / ``make_eval_chunk``) compiles K
+consecutive steps into ONE dispatch, with the device-resident batch gather
+(``data/pipeline.gather_resident_batch``) moved inside the loop: the per-chunk
+host→device traffic is a ``[K, B]`` int32 permutation block, and per-step
+metrics come back stacked — fetched once, not K times. On the relay-attached
+hosts this repo runs on, each dispatch costs ~25 ms (``tools/
+profile_dispatch.py`` measures it), so K steps per dispatch divides the
+dispatch tax by K.
+
+Bit-exactness contract: chunked training must produce BIT-IDENTICAL results to
+the per-step path (``tests/test_chunked.py`` pins it). The scan is therefore
+fully unrolled (``unroll=True``): XLA compiles a rolled ``while`` loop body
+with different fusion/rounding than the standalone step program (measured ULP
+drift on the CPU lane), while the unrolled chunk is the same flat step program
+repeated K times — identical math, one dispatch. Unrolling is also why chunk
+sizes are clamped (``train/loop.MAX_CHUNK_STEPS``): program size grows with K.
 """
 
 from __future__ import annotations
@@ -25,8 +42,44 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..data.pipeline import gather_resident_batch
 from ..ops.scores import cross_entropy
 from .state import TrainState
+
+
+def _train_step_math(model, augment, state: TrainState, batch):
+    """One optimizer step — THE training math, shared verbatim by the
+    per-dispatch step and the chunked scan body so the two cannot drift."""
+    mask = batch["mask"]
+    image = batch["image"]
+    if augment is not None:
+        from ..data.augment import augment_images
+        image = augment_images(state.step, image, crop_pad=augment[0],
+                               flip=augment[1], seed=augment[2])
+
+    def loss_fn(params):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": state.batch_stats},
+            image, train=True, mutable=["batch_stats"])
+        per_ex = cross_entropy(logits, batch["label"]) * mask
+        loss = jnp.sum(per_ex) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, (logits, updates["batch_stats"])
+
+    (loss, (logits, new_stats)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
+    state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+    correct = jnp.sum((jnp.argmax(logits, -1) == batch["label"]) * mask)
+    metrics = {"loss": loss, "correct": correct, "examples": jnp.sum(mask)}
+    return state, metrics
+
+
+def _eval_step_math(model, state: TrainState, batch):
+    mask = batch["mask"]
+    logits = model.apply(state.variables, batch["image"], train=False)
+    per_ex = cross_entropy(logits, batch["label"]) * mask
+    correct = jnp.sum((jnp.argmax(logits, -1) == batch["label"]) * mask)
+    return {"loss_sum": jnp.sum(per_ex), "correct": correct,
+            "examples": jnp.sum(mask)}
 
 
 # functools.cache: Flax modules are frozen dataclasses (hashable by config), so the
@@ -38,39 +91,84 @@ from .state import TrainState
 @functools.cache
 def make_train_step(model, augment: tuple[int, bool, int] | None = None):
     def train_step(state: TrainState, batch):
-        mask = batch["mask"]
-        image = batch["image"]
-        if augment is not None:
-            from ..data.augment import augment_images
-            image = augment_images(state.step, image, crop_pad=augment[0],
-                                   flip=augment[1], seed=augment[2])
-
-        def loss_fn(params):
-            logits, updates = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                image, train=True, mutable=["batch_stats"])
-            per_ex = cross_entropy(logits, batch["label"]) * mask
-            loss = jnp.sum(per_ex) / jnp.maximum(jnp.sum(mask), 1.0)
-            return loss, (logits, updates["batch_stats"])
-
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        state = state.apply_gradients(grads=grads, batch_stats=new_stats)
-        correct = jnp.sum((jnp.argmax(logits, -1) == batch["label"]) * mask)
-        metrics = {"loss": loss, "correct": correct, "examples": jnp.sum(mask)}
-        return state, metrics
+        return _train_step_math(model, augment, state, batch)
 
     return jax.jit(train_step, donate_argnums=(0,))
 
 
 @functools.cache
+def make_train_chunk(model, augment: tuple[int, bool, int] | None = None,
+                     out_sharding=None):
+    """K consecutive train steps as ONE dispatch (K = ``idx.shape[0]``, a
+    shape — one compilation per distinct chunk length, i.e. the epoch body
+    plus at most one tail).
+
+    ``train_chunk(state, images, labels, indices, idx, mask)``: the resident
+    arrays stay on device across chunks; ``idx``/``mask`` are ``[K, B]``
+    blocks from ``ResidentBatches.chunk_indices``. The gather runs INSIDE the
+    chunk, so the dispatch's host-side input is just the permutation block.
+    Returns ``(state, metrics)`` with per-step metrics stacked to ``[K]`` —
+    kept per-step (not reduced on device) so the host computes the epoch
+    record from exactly the same scalars, in the same order, as the per-step
+    path: bit-identical history is the engine's correctness contract.
+    ``out_sharding`` (hashable ``NamedSharding``) is the resident gather's
+    data-axis layout constraint. State is donated through the scan.
+
+    Like ``make_train_step``, the ``augment`` tuple embeds the training seed,
+    so augmented MULTI-SEED scoring pretrains compile one chunk per seed —
+    the per-step path's documented trade (data/augment.py), amplified here by
+    the unrolled program size. Accepted deliberately: threading the seed in
+    as a traced operand would fork the augment plumbing between the two
+    engines, weakening the shared-math property the bit-exactness contract
+    rests on, to optimize a rare configuration (augmentation during short
+    scoring pretrains).
+    """
+    def train_chunk(state: TrainState, images, labels, indices, idx, mask):
+        def body(carry, xs):
+            take, m = xs
+            batch = gather_resident_batch(images, labels, indices, take, m,
+                                          out_sharding)
+            return _train_step_math(model, augment, carry, batch)
+
+        if idx.shape[0] == 1:
+            # A length-1 scan — an epoch tail — compiles with different
+            # rounding than the bare step program even unrolled (measured on
+            # the CPU lane); the bare fused gather+step is bit-identical, so
+            # the tail takes it directly.
+            state, metrics = body(state, (idx[0], mask[0]))
+            return state, {k: v[None] for k, v in metrics.items()}
+        # unroll=True: see module docstring — a rolled loop body compiles with
+        # different rounding than the per-dispatch step; the unrolled chunk is
+        # the identical step program repeated, so chunked == per-step bitwise.
+        return jax.lax.scan(body, state, (idx, mask), unroll=True)
+
+    return jax.jit(train_chunk, donate_argnums=(0,))
+
+
+@functools.cache
+def make_eval_chunk(model, out_sharding=None):
+    """K eval batches per dispatch over the resident arrays — the eval-side
+    twin of ``make_train_chunk`` (same gather, same unroll-for-exactness);
+    returns the per-batch sum dicts stacked to ``[K]`` for a single fetch."""
+    def eval_chunk(state: TrainState, images, labels, indices, idx, mask):
+        def body(carry, xs):
+            take, m = xs
+            batch = gather_resident_batch(images, labels, indices, take, m,
+                                          out_sharding)
+            return carry, _eval_step_math(model, state, batch)
+
+        if idx.shape[0] == 1:   # length-1 scan ≠ bare step bitwise; see above
+            _, out = body(0, (idx[0], mask[0]))
+            return {k: v[None] for k, v in out.items()}
+        _, out = jax.lax.scan(body, 0, (idx, mask), unroll=True)
+        return out
+
+    return jax.jit(eval_chunk)
+
+
+@functools.cache
 def make_eval_step(model):
     def eval_step(state: TrainState, batch):
-        mask = batch["mask"]
-        logits = model.apply(state.variables, batch["image"], train=False)
-        per_ex = cross_entropy(logits, batch["label"]) * mask
-        correct = jnp.sum((jnp.argmax(logits, -1) == batch["label"]) * mask)
-        return {"loss_sum": jnp.sum(per_ex), "correct": correct,
-                "examples": jnp.sum(mask)}
+        return _eval_step_math(model, state, batch)
 
     return jax.jit(eval_step)

@@ -1,0 +1,307 @@
+"""Chunked execution engine (K train steps per dispatch, train/steps.py).
+
+The engine's contract has two halves, both pinned here:
+
+* it is a PURE performance transform — a chunked ``fit`` produces
+  bit-identical final params/batch_stats/opt_state and identical epoch
+  history (eval metrics included) to the per-step path, with and without
+  on-device augmentation, tail chunks included;
+* resilience semantics survive at chunk granularity — a SIGTERM is honored
+  within one chunk (durable final checkpoint, clean ``Preempted``), an
+  injected NaN epoch loss still raises before the checkpoint save, the
+  watchdog deadline scales with the chunk size, and step-targeted fault
+  injection routes the run back to the per-step engine where exact-step
+  coordinates exist.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.checkpoint import CheckpointManager
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.resilience.preemption import Preempted
+from data_diet_distributed_tpu.resilience.sentinel import DivergenceError
+from data_diet_distributed_tpu.train import loop as loop_mod
+from data_diet_distributed_tpu.train.loop import (DEFAULT_CHUNK_STEPS,
+                                                  MAX_CHUNK_STEPS, evaluate,
+                                                  fit, resolve_chunk_steps)
+
+#: Wall-clock fields — everything else in an epoch record must be identical
+#: between the chunked and per-step engines.
+WALL_KEYS = ("epoch_s", "examples_per_s")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    inject.deactivate()
+
+
+def _mk_cfg(tmp_path, *extra):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=2", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=1",
+        "train.device_resident_data=true",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0", *extra])
+
+
+def _strip_wall(history):
+    return [{k: v for k, v in rec.items() if k not in WALL_KEYS}
+            for rec in history]
+
+
+def _assert_state_bit_identical(a, b):
+    la = jax.tree.leaves((a.params, a.batch_stats, a.opt_state))
+    lb = jax.tree.leaves((b.params, b.batch_stats, b.opt_state))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _events(cfg, kind):
+    with open(cfg.obs.metrics_path) as fh:
+        return [e for e in (json.loads(line) for line in fh if line.strip())
+                if e["kind"] == kind]
+
+
+# ------------------------------------------------------------ bit-exactness
+
+
+def test_chunked_fit_bit_identical(tmp_path, mesh8, tiny_ds):
+    """chunk_steps=3 over 4 steps/epoch (a 3-chunk plus a 1-step tail, the
+    worst case) vs per-step: same final state bits, same history — eval
+    metrics included, since the chunked eval path rides the same engine."""
+    train_ds, test_ds = tiny_ds
+    r1 = fit(_mk_cfg(tmp_path / "a", "train.chunk_steps=1"), train_ds,
+             test_ds, mesh=mesh8)
+    r3 = fit(_mk_cfg(tmp_path / "b", "train.chunk_steps=3"), train_ds,
+             test_ds, mesh=mesh8)
+    assert r1.chunk_steps == 1 and r3.chunk_steps == 3
+    assert _strip_wall(r1.history) == _strip_wall(r3.history)
+    assert "test_accuracy" in r1.history[-1]   # eval rode along and matched
+    assert int(r1.state.step) == int(r3.state.step) == 8
+    _assert_state_bit_identical(r1.state, r3.state)
+
+
+def test_chunked_fit_bit_identical_augmented(tmp_path, mesh8, tiny_ds):
+    """With on-device augmentation the per-step RNG stream is keyed off
+    state.step INSIDE the chunk — the trajectories must still match bitwise."""
+    train_ds, _ = tiny_ds
+    r1 = fit(_mk_cfg(tmp_path / "a", "train.chunk_steps=1",
+                     "data.augment=true"), train_ds, None, mesh=mesh8)
+    r4 = fit(_mk_cfg(tmp_path / "b", "train.chunk_steps=4",
+                     "data.augment=true"), train_ds, None, mesh=mesh8)
+    assert _strip_wall(r1.history) == _strip_wall(r4.history)
+    _assert_state_bit_identical(r1.state, r4.state)
+
+
+def test_evaluate_chunked_matches_per_batch(tmp_path, mesh8, tiny_ds):
+    """evaluate() with a resident set and chunk_steps>1 runs K batches per
+    dispatch and must report the exact per-batch-path metrics."""
+    from data_diet_distributed_tpu.data.pipeline import (BatchSharder,
+                                                         maybe_resident)
+    from data_diet_distributed_tpu.models import create_model_from_cfg
+
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.num_epochs=1")
+    res = fit(cfg, train_ds, None, mesh=mesh8)
+    model = create_model_from_cfg(cfg)
+    sharder = BatchSharder(mesh8)
+    bs = sharder.global_batch_size_for(64)
+    resident = maybe_resident(train_ds, mesh8, bs, np.float32, enabled=True)
+    ev_stream = evaluate(model, res.state, train_ds, sharder, 64)
+    ev_batch = evaluate(model, res.state, train_ds, sharder, 64,
+                        resident=resident, chunk_steps=1)
+    ev_chunk = evaluate(model, res.state, train_ds, sharder, 64,
+                        resident=resident, chunk_steps=3)
+    assert ev_chunk == ev_batch == ev_stream
+    assert ev_chunk["examples"] == len(train_ds)
+
+
+def test_resident_chunk_indices_composition(mesh8):
+    """chunk_indices must reproduce __call__'s exact epoch composition:
+    permutation order, row-0 tail padding with mask=0, remainder tail chunk."""
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import (ResidentBatches,
+                                                         epoch_permutation)
+
+    ds, _ = load_dataset("synthetic", synthetic_size=100, seed=0)
+    res = ResidentBatches(ds, mesh8, batch_size=32)
+    blocks = list(res.chunk_indices(3, shuffle=True, seed=7, epoch=2))
+    assert [b[0].shape[0] for b in blocks] == [3, 1]   # ceil(4/3) chunks
+    idx = np.concatenate([b[0] for b in blocks]).reshape(-1)
+    mask = np.concatenate([b[1] for b in blocks]).reshape(-1)
+    perm = epoch_permutation(100, 7, 2)
+    np.testing.assert_array_equal(idx[:100], perm)
+    np.testing.assert_array_equal(idx[100:], 0)        # row-0 tail padding
+    np.testing.assert_array_equal(mask[:100], 1.0)
+    np.testing.assert_array_equal(mask[100:], 0.0)
+
+
+# ------------------------------------------------- selection / fallback logic
+
+
+def test_chunk_steps_selection_and_fallbacks(tmp_path):
+    resident = object()   # any non-None stands in for a ResidentBatches
+    cfg = _mk_cfg(tmp_path)
+
+    # Auto: on for resident single-process runs, sized by the default and
+    # clamped to the epoch length.
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == DEFAULT_CHUNK_STEPS
+    assert resolve_chunk_steps(cfg, 4, resident, None) == 4
+    # Streaming and consensus always fall back to per-step.
+    assert resolve_chunk_steps(cfg, 1000, None, None) == 1
+    assert resolve_chunk_steps(cfg, 1000, resident, object()) == 1
+    # Explicit off / explicit size / clamp to MAX_CHUNK_STEPS.
+    cfg.train.chunk_steps = 0
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == 1
+    cfg.train.chunk_steps = 1
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == 1
+    cfg.train.chunk_steps = 8
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == 8
+    cfg.train.chunk_steps = 100000
+    assert resolve_chunk_steps(cfg, 100000, resident, None) == MAX_CHUNK_STEPS
+    # Step-targeted injection needs the per-step loop; epoch-targeted doesn't.
+    cfg.train.chunk_steps = 8
+    inject.activate(inject.FaultPlan(sigterm_at_step=2))
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == 1
+    inject.activate(inject.FaultPlan(nan_loss_at_epoch=0))
+    assert resolve_chunk_steps(cfg, 1000, resident, None) == 8
+    inject.deactivate()
+
+
+def test_chunk_steps_config_validation():
+    with pytest.raises(ValueError, match="chunk_steps"):
+        load_config(None, ["train.chunk_steps=-1"])
+    assert load_config(None, ["train.chunk_steps=0"]).train.chunk_steps == 0
+    assert load_config(None, []).train.chunk_steps is None
+
+
+def test_chunked_event_logged_and_result_carries_engine(tmp_path, mesh8,
+                                                        tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.chunk_steps=2", "train.num_epochs=1",
+                  "train.log_every_steps=2")
+    res = fit(cfg, train_ds, None, mesh=mesh8,
+              logger=MetricsLogger(cfg.obs.metrics_path, echo=False))
+    assert res.chunk_steps == 2
+    ev = _events(cfg, "train_chunked")
+    assert ev and ev[0]["chunk_steps"] == 2 and ev[0]["steps_per_epoch"] == 4
+    # log_every_steps hoists to chunk boundaries rather than vanishing: with
+    # K=2 over 4 steps and log_every=2, both boundaries emit liveness events.
+    steps = [e["step"] for e in _events(cfg, "train_step")]
+    assert steps == [2, 4]
+
+
+# ------------------------------------------- resilience at chunk boundaries
+
+
+def test_sigterm_honored_within_one_chunk(tmp_path, mesh8, tiny_ds,
+                                          monkeypatch):
+    """A real SIGTERM landing while a chunk is in flight must be honored at
+    the NEXT chunk boundary: final synchronous checkpoint, Preempted carrying
+    that exact step — never more than one chunk of extra steps."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.chunk_steps=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    real = loop_mod._dispatch_chunk
+    calls = []
+
+    def sigterm_after_first_chunk(chunk_fn, state, resident, idx, mask):
+        out = real(chunk_fn, state, resident, idx, mask)
+        calls.append(idx.shape[0])
+        if len(calls) == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return out
+
+    monkeypatch.setattr(loop_mod, "_dispatch_chunk", sigterm_after_first_chunk)
+    with pytest.raises(Preempted) as exc_info:
+        fit(cfg, train_ds, None, mesh=mesh8, logger=logger,
+            checkpoint_dir=cfg.train.checkpoint_dir)
+    # The first chunk (2 steps) completed; the signal was honored at its
+    # boundary — exactly one chunk's latency, not an epoch's.
+    assert exc_info.value.step == 2
+    assert exc_info.value.durable_step == 2
+    assert len(calls) == 1
+    ev = _events(cfg, "preempted")
+    assert ev and ev[0]["signal"] == "SIGTERM" and ev[0]["durable_step"] == 2
+
+    # Resume from the mid-epoch checkpoint and finish cleanly, chunked.
+    monkeypatch.setattr(loop_mod, "_dispatch_chunk", real)
+    cfg.train.resume = True
+    res = fit(cfg, train_ds, None, mesh=mesh8, logger=logger,
+              checkpoint_dir=cfg.train.checkpoint_dir)
+    assert res.chunk_steps == 2
+    assert int(res.state.step) == 10   # 2 saved + replayed epoch 0 + epoch 1
+    assert len(res.history) == 2
+
+
+def test_chunked_nan_sentinel_raises_before_checkpoint(tmp_path, mesh8,
+                                                       tiny_ds):
+    """The NaN verdict is an epoch-boundary check either way — under the
+    chunked engine the diverged state must still never become durable."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.chunk_steps=4")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    inject.activate(inject.FaultPlan(nan_loss_at_epoch=0))
+    with pytest.raises(DivergenceError):
+        fit(cfg, train_ds, None, mesh=mesh8, logger=logger,
+            checkpoint_dir=cfg.train.checkpoint_dir)
+    mngr = CheckpointManager(cfg.train.checkpoint_dir)
+    try:
+        assert mngr.latest_step() is None   # nothing durable pre-divergence
+    finally:
+        mngr.close()
+    faults = _events(cfg, "fault")
+    assert [f["fault"] for f in faults] == ["divergence"]
+
+
+def test_chunked_watchdog_deadline_scales_with_chunk(tmp_path, mesh8, tiny_ds,
+                                                     monkeypatch):
+    """One heartbeat per chunk means the deadline must cover K steps: the
+    watchdog is constructed with step_timeout_s * chunk_steps."""
+    from data_diet_distributed_tpu.resilience.watchdog import Watchdog
+
+    seen = []
+
+    class Recording(Watchdog):
+        def __init__(self, timeout_s, *a, **kw):
+            seen.append(timeout_s)
+            super().__init__(timeout_s, *a, **kw)
+
+    monkeypatch.setattr(loop_mod, "Watchdog", Recording)
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path / "a", "train.chunk_steps=4", "train.num_epochs=1",
+                  "resilience.step_timeout_s=30")
+    fit(cfg, train_ds, None, mesh=mesh8)
+    cfg1 = _mk_cfg(tmp_path / "b", "train.chunk_steps=1", "train.num_epochs=1",
+                   "resilience.step_timeout_s=30")
+    fit(cfg1, train_ds, None, mesh=mesh8)
+    assert seen == [120, 30]
+
+
+def test_step_targeted_sigterm_falls_back_to_per_step(tmp_path, mesh8,
+                                                      tiny_ds):
+    """An armed exact-step SIGTERM injection under a chunked config must run
+    the per-step engine: honored before step 2's poll (Preempted at step 3,
+    matching the per-step test), not at a chunk-4 boundary."""
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "train.chunk_steps=4", "train.num_epochs=1")
+    inject.activate(inject.FaultPlan(sigterm_at_step=2))
+    with pytest.raises(Preempted) as exc_info:
+        fit(cfg, train_ds, None, mesh=mesh8,
+            logger=MetricsLogger(cfg.obs.metrics_path, echo=False),
+            checkpoint_dir=cfg.train.checkpoint_dir)
+    assert exc_info.value.step == 3   # per-step granularity, not chunk

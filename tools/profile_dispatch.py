@@ -1,0 +1,133 @@
+"""Per-dispatch overhead of the training step, by difference quotient.
+
+The chunked execution engine (``train/steps.make_train_chunk``) exists because
+every dispatch on this repo's relay-attached hosts costs ~25 ms of host↔device
+latency. This tool MEASURES that tax through the production chunk program
+itself, the same way ``tools/profile_grand.py`` times kernels: one dispatch of
+a K-step chunk costs ``t(K) = overhead + K * t_step``, so two chunk lengths
+give both unknowns without ever trusting a host-side timer around a single
+op::
+
+    t_step   = (t(K_long) - t(1)) / (K_long - 1)     # dispatch tax cancels
+    overhead = t(1) - t_step
+
+From those it derives the chunk size at which the dispatch tax drops below
+``--frac`` of compute — the measurement behind
+``train/loop.DEFAULT_CHUNK_STEPS``.
+
+Run: ``python tools/profile_dispatch.py [--arch resnet18] [--batch 1024]
+[--k-long 16] [--frac 0.05]`` (add ``JAX_PLATFORMS=cpu`` for the CPU lane —
+the numbers then describe CPU dispatch, useful only for relative sanity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from data_diet_distributed_tpu.config import load_config  # noqa: E402
+from data_diet_distributed_tpu.data.datasets import load_dataset  # noqa: E402
+from data_diet_distributed_tpu.data.pipeline import (BatchSharder,  # noqa: E402
+                                                     ResidentBatches)
+from data_diet_distributed_tpu.models import create_model_from_cfg  # noqa: E402
+from data_diet_distributed_tpu.parallel.mesh import (make_mesh,  # noqa: E402
+                                                     place_state)
+from data_diet_distributed_tpu.train.loop import MAX_CHUNK_STEPS  # noqa: E402
+from data_diet_distributed_tpu.train.state import create_train_state  # noqa: E402
+from data_diet_distributed_tpu.train.steps import make_train_chunk  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--size", type=int, default=None,
+                    help="synthetic dataset size (default: --batch)")
+    ap.add_argument("--k-long", type=int, default=16,
+                    help="long chunk length for the difference quotient")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timing repetitions (min is reported)")
+    ap.add_argument("--frac", type=float, default=0.05,
+                    help="target dispatch-tax fraction for the recommended "
+                         "chunk size")
+    ap.add_argument("--no-half", action="store_true",
+                    help="fp32 compute (CPU-lane runs)")
+    args = ap.parse_args()
+    if args.k_long < 2:
+        raise SystemExit("--k-long must be >= 2 for a difference quotient")
+
+    size = args.size or args.batch
+    cfg = load_config(None, [
+        "data.dataset=synthetic", f"data.synthetic_size={size}",
+        f"data.batch_size={args.batch}", f"model.arch={args.arch}",
+        f"train.half_precision={'false' if args.no_half else 'true'}",
+        "train.log_every_steps=100000"])
+    mesh = make_mesh(cfg.mesh)
+    sharder = BatchSharder(mesh)
+    batch = sharder.global_batch_size_for(args.batch)
+    train_ds, _ = load_dataset("synthetic", synthetic_size=size, seed=0)
+    image_dtype = np.float32 if args.no_half else "bfloat16"
+    resident = ResidentBatches(train_ds, mesh, batch, image_dtype)
+    model = create_model_from_cfg(cfg)
+    state = create_train_state(cfg, jax.random.key(0), steps_per_epoch=1,
+                               sample_shape=(1, *train_ds.images.shape[1:]))
+    state = place_state(state, mesh)
+    chunk_fn = make_train_chunk(model, None, resident.out_sharding)
+
+    def block(k: int):
+        idx = (np.arange(k * batch, dtype=np.int64) % resident.n).astype(
+            np.int32).reshape(k, batch)
+        return idx, np.ones((k, batch), np.float32)
+
+    def dispatch(state, k: int) -> tuple[float, object]:
+        """One chunked dispatch of k steps; the metrics fetch is the barrier
+        (block_until_ready is not reliable on every backend — see bench.py)."""
+        import jax.numpy as jnp
+        idx, mask = block(k)
+        t0 = time.perf_counter()
+        state, metrics = chunk_fn(state, resident.images, resident.labels,
+                                  resident.indices, jnp.asarray(idx),
+                                  jnp.asarray(mask))
+        jax.device_get(metrics)
+        return time.perf_counter() - t0, state
+
+    for k in (1, args.k_long):            # compile both program lengths
+        _, state = dispatch(state, k)
+    t1 = tl = float("inf")
+    for _ in range(args.reps):
+        dt, state = dispatch(state, 1)
+        t1 = min(t1, dt)
+        dt, state = dispatch(state, args.k_long)
+        tl = min(tl, dt)
+
+    t_step = (tl - t1) / (args.k_long - 1)
+    overhead = t1 - t_step
+    print(f"arch={args.arch} batch={batch} devices={len(jax.devices())} "
+          f"({jax.devices()[0].platform})")
+    print(f"t(1)        = {t1 * 1e3:8.2f} ms   (one dispatch, one step)")
+    print(f"t({args.k_long:<2})       = {tl * 1e3:8.2f} ms   "
+          f"(one dispatch, {args.k_long} steps)")
+    print(f"per-step    = {t_step * 1e3:8.2f} ms   "
+          f"({batch / max(t_step, 1e-9):9.0f} ex/s device-side)")
+    print(f"per-dispatch overhead = {overhead * 1e3:.2f} ms "
+          f"({100 * overhead / max(t1, 1e-9):.0f}% of a single-step dispatch)")
+    if overhead <= 0 or t_step <= 0:
+        print("overhead within measurement noise — chunking buys nothing "
+              "here; train.chunk_steps=1 is fine")
+        return
+    rec = int(np.ceil(overhead / (args.frac * t_step)))
+    rec = max(1, min(rec, MAX_CHUNK_STEPS))
+    print(f"recommended train.chunk_steps >= {rec} "
+          f"(dispatch tax <= {args.frac:.0%} of compute; clamp "
+          f"{MAX_CHUNK_STEPS})")
+
+
+if __name__ == "__main__":
+    main()
