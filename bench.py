@@ -248,10 +248,15 @@ def bench_score(args, metric: str) -> None:
         outs = [step(variables, b) for b in device_batches]
         return float(jax.device_get(_checksum(outs)))
 
+    from data_diet_distributed_tpu.obs import StepTimer
+
     run_pass()  # warmup: compile + one full pass
+    timer = StepTimer(warmup=0)   # warmup pass already excluded above
     t0 = time.perf_counter()
     for _ in range(args.repeats):
+        t_pass = time.perf_counter()
         run_pass()
+        timer.record(time.perf_counter() - t_pass)
     wall = time.perf_counter() - t0
 
     examples_per_sec = args.size * args.repeats / wall
@@ -259,6 +264,10 @@ def bench_score(args, metric: str) -> None:
     vs_baseline = per_chip / (NORTH_STAR_EXAMPLES_PER_SEC / NORTH_STAR_CHIPS)
 
     extra = {"mesh": args.mesh} if args.mesh else {}
+    # Tail latency next to the mean: p50/p95/max over the timed passes (the
+    # StepTimer quantile extension) — a relay hiccup or GC stall shows up
+    # here while the mean smooths it away.
+    extra["pass_s"] = timer.summary(digits=4)
     emit(metric, round(per_chip, 1), "examples/sec/chip",
          round(vs_baseline, 4), **extra)
 
@@ -355,9 +364,12 @@ def bench_train(args, metric: str) -> None:
     train_ds, _ = load_dataset(args.dataset, synthetic_size=args.size, seed=0)
     sharder = BatchSharder(mesh)
     res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder)
-    # Epoch 0 pays upload + compile; report the steady-state epochs.
-    steady = res.history[1:]
-    per_sec = sum(h["examples_per_s"] for h in steady) / len(steady)
+    # The run's own terminal summary (FitResult.throughput_summary — the same
+    # derivation the CLI's run_summary JSONL event carries: epoch 0 = warmup,
+    # steady-state mean + epoch-wall quantiles) is preferred over re-deriving
+    # the numbers here; the BENCH JSON embeds it.
+    summary = res.throughput_summary()
+    per_sec = summary["examples_per_s"]
     per_chip = per_sec / len(jax.devices())
     extra = {"mesh": args.mesh} if args.mesh else {}
     # Dispatch accounting: the chunked engine's whole point is fewer, larger
@@ -366,11 +378,12 @@ def bench_train(args, metric: str) -> None:
     spe = num_batches(len(train_ds),
                       sharder.global_batch_size_for(cfg.data.batch_size))
     dispatches_per_epoch = -(-spe // res.chunk_steps)
-    mean_epoch_s = sum(h["epoch_s"] for h in steady) / len(steady)
+    mean_epoch_s = summary["epoch_s"]["mean"]
     extra.update(chunk_steps=res.chunk_steps,
                  dispatches_per_epoch=dispatches_per_epoch,
                  dispatches_per_sec=round(dispatches_per_epoch / mean_epoch_s,
-                                          2))
+                                          2),
+                 epoch_s=summary["epoch_s"])
     emit(metric, round(per_chip, 1), "examples/sec/chip",
          round(per_chip / TRAIN_BUDGET_PER_CHIP, 4), **extra)
 

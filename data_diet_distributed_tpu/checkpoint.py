@@ -18,6 +18,8 @@ from typing import TYPE_CHECKING, Any
 import jax
 import orbax.checkpoint as ocp
 
+from .obs import registry as obs_registry
+from .obs import tracing
 from .resilience.integrity import (CheckpointCorrupt, build_manifest,
                                    verify_restored)
 
@@ -58,16 +60,23 @@ class CheckpointManager:
         # waited on here (not at the end of this one) — the stall shrinks from
         # full-serialization-per-save to only what the intervening epoch didn't
         # already cover. Readers (latest_step/all_steps/restore/close) barrier.
-        self._mngr.wait_until_finished()
-        if step in self._mngr.all_steps():
-            # A stale checkpoint from an earlier run sharing this directory (same
-            # step numbering) — overwrite it; Orbax otherwise raises
-            # StepAlreadyExistsError and the stale payload would shadow this run.
-            self._mngr.delete(step)
-        # force=True: Orbax's default policy silently skips saves at steps <= the
-        # directory's latest step, so a stale HIGHER-numbered checkpoint would
-        # otherwise swallow every save this run makes.
-        self._mngr.save(step, args=ocp.args.Composite(**composite), force=True)
+        # The span/histogram therefore measures the DISPATCH cost the training
+        # loop actually pays (previous-save barrier + array snapshot), which is
+        # exactly the stall a perf investigation needs to see.
+        with tracing.span("checkpoint_save", cat="checkpoint", step=step), \
+                obs_registry.timed("checkpoint_save_s"):
+            self._mngr.wait_until_finished()
+            if step in self._mngr.all_steps():
+                # A stale checkpoint from an earlier run sharing this directory
+                # (same step numbering) — overwrite it; Orbax otherwise raises
+                # StepAlreadyExistsError and the stale payload would shadow
+                # this run.
+                self._mngr.delete(step)
+            # force=True: Orbax's default policy silently skips saves at steps
+            # <= the directory's latest step, so a stale HIGHER-numbered
+            # checkpoint would otherwise swallow every save this run makes.
+            self._mngr.save(step, args=ocp.args.Composite(**composite),
+                            force=True)
 
     def latest_step(self) -> int | None:
         self._mngr.wait_until_finished()
@@ -87,8 +96,11 @@ class CheckpointManager:
         template = {"params": state.params, "batch_stats": state.batch_stats,
                     "opt_state": state.opt_state, "step": state.step}
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        restored = self._mngr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)))
+        with tracing.span("checkpoint_restore", cat="checkpoint", step=step), \
+                obs_registry.timed("checkpoint_restore_s"):
+            restored = self._mngr.restore(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)))
         payload = restored["state"]
         return state.replace(params=payload["params"],
                              batch_stats=payload["batch_stats"],

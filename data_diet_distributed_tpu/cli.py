@@ -17,7 +17,8 @@ import argparse
 import sys
 
 from .config import Config, load_config
-from .obs import MetricsLogger, ResourceMonitor, plot_metrics, plot_utilization
+from .obs import (MetricsLogger, ResourceMonitor, plot_metrics,
+                  plot_utilization, tracing)
 
 
 def _build(argv: list[str]) -> tuple[str, Config]:
@@ -30,7 +31,11 @@ def _build(argv: list[str]) -> tuple[str, Config]:
                              "per prune.sweep sparsity level")
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("overrides", nargs="*", help="dotted.key=value overrides")
-    args = parser.parse_args(argv)
+    # parse_intermixed_args, NOT parse_args: the documented invocation puts
+    # overrides AFTER --config (`run --config x.yaml k=v`), which plain
+    # argparse rejects ("unrecognized arguments" — positionals after an
+    # optional can't join an already-consumed nargs=* group).
+    args = parser.parse_intermixed_args(argv)
     return args.command, load_config(args.config, args.overrides)
 
 
@@ -66,20 +71,54 @@ def main(argv: list[str] | None = None) -> int:
     if monitor:
         monitor.start()
     logger = MetricsLogger(cfg.obs.metrics_path)
-    from .obs import trace
+    from .obs import emit_run_summary, trace
+    from .obs.session import ObsSession
     preempted: Preempted | None = None
-    try:
-        with trace(cfg.obs.profile_dir):
-            _dispatch(command, cfg, logger)
-    except Preempted as p:
-        # Clean preemption exit: the final checkpoint is durable and the
-        # "preempted" event is already in the metrics JSONL — report the exact
-        # resume point and a status a supervisor can branch on.
-        preempted = p
-    finally:
-        logger.close()
-        if monitor:
-            monitor.stop()
+    final: dict | None = None
+    exit_class = "ok"
+    mono0 = time.perf_counter()
+    # ObsSession: build + install the unified observability layer — trace
+    # spans, metrics registry, per-rank heartbeats, fault flight recorder —
+    # for the run's duration (entered after multihost init: per-rank paths).
+    with ObsSession(cfg) as obs:
+        try:
+            with trace(cfg.obs.profile_dir), \
+                    tracing.span("run", cat="run", command=command):
+                final = _dispatch(command, cfg, logger)
+        except Preempted as p:
+            # Clean preemption exit: the final checkpoint is durable and the
+            # "preempted" event is already in the metrics JSONL — report the
+            # exact resume point and a status a supervisor can branch on.
+            preempted = p
+            exit_class = "preempted"
+        except BaseException as exc:   # noqa: BLE001 — classify, then re-raise
+            # BaseException, not Exception: a Ctrl-C outside the preemption
+            # window (data loading, scoring setup) must not leave a terminal
+            # run_summary claiming exit_class "ok" for an aborted run.
+            exit_class = f"fatal:{type(exc).__name__}"
+            raise
+        finally:
+            # Terminal run_summary: LAST JSONL line of the run (the final
+            # registry snapshot precedes it, so nothing follows it).
+            # Best-effort BY CONTRACT: a full disk raising from the JSONL
+            # write here must not mask the run's real outcome — neither the
+            # in-flight exception nor a clean 0/75 exit status.
+            try:
+                if obs.registry is not None:
+                    logger.log("metrics", **obs.registry.snapshot())
+                emit_run_summary(logger, wall_s=time.perf_counter() - mono0,
+                                 exit_class=exit_class, command=command,
+                                 final=final, registry=obs.registry)
+            except Exception as exc:   # noqa: BLE001
+                print(f"[obs] run_summary emission failed: {exc!r}",
+                      file=sys.stderr, flush=True)
+            finally:
+                try:
+                    logger.close()
+                except Exception:   # noqa: BLE001 — same contract as above
+                    pass
+                if monitor:
+                    monitor.stop()
     if preempted is not None:
         print(f"[preempted] {preempted}", flush=True)
         return EXIT_PREEMPTED
@@ -117,18 +156,30 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
-def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
+def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> dict | None:
+    """Run the command; returns its FINAL metrics (the ``run_summary``
+    terminal event's ``final`` block)."""
     if command == "run":
         from .train.loop import run_datadiet
-        run_datadiet(cfg, logger)
+        summary = run_datadiet(cfg, logger)
+        return {k: summary.get(k) for k in
+                ("final_test_accuracy", "sparsity", "score_method", "n_kept",
+                 "total_wall_s")}
     elif command == "sweep":
         from .train.loop import run_sweep
-        run_sweep(cfg, logger)
+        summaries = run_sweep(cfg, logger)
+        return {"levels": [s.get("sparsity") for s in summaries],
+                "final_test_accuracy": [s.get("final_test_accuracy")
+                                        for s in summaries]}
     elif command == "train":
         from .train.loop import fit_with_recovery, load_data_for
         train_ds, test_ds = load_data_for(cfg)
-        fit_with_recovery(cfg, train_ds, test_ds, logger=logger,
-                          checkpoint_dir=cfg.train.checkpoint_dir, tag="dense")
+        res = fit_with_recovery(cfg, train_ds, test_ds, logger=logger,
+                                checkpoint_dir=cfg.train.checkpoint_dir,
+                                tag="dense")
+        # ONE derivation of the headline numbers (FitResult.throughput_
+        # summary) — bench.py reads the same summary instead of re-deriving.
+        return res.throughput_summary()
     elif command == "score":
         from .data.pipeline import BatchSharder
         from .parallel.mesh import is_primary, make_mesh
@@ -156,6 +207,10 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> None:
                    mean=float(scores.mean()), std=float(scores.std()),
                    score_s=round(score_t["score_s"], 3),
                    pretrain_s=round(score_t["pretrain_s"], 3))
+        return {"n_scores": int(len(scores)), "scores_npz": out,
+                "score_s": round(score_t["score_s"], 3),
+                "pretrain_s": round(score_t["pretrain_s"], 3)}
+    return None
 
 
 if __name__ == "__main__":

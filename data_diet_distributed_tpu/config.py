@@ -259,13 +259,52 @@ class ResilienceConfig:
 
 @dataclass
 class ObsConfig:
-    """Observability (reference: prints + ``ddp_new.py:21-99`` sidecar monitor)."""
+    """Observability (reference: prints + ``ddp_new.py:21-99`` sidecar monitor).
+
+    The unified layer (``obs/``): hierarchical trace spans (Chrome-trace
+    JSON), a metrics registry (counters/gauges/streaming histograms,
+    snapshotted into the JSONL and optionally a Prometheus textfile),
+    per-rank heartbeat files, and a per-rank fault flight recorder. All four
+    are wired by the CLI's ``ObsSession``; library code reaches them through
+    module-level no-op-until-installed helpers, so running without the
+    session costs one ``is None`` check per hook."""
 
     metrics_path: str = "./metrics.jsonl"
     monitor: bool = False            # 1 Hz host/device utilization sampling thread
     monitor_path: str = "./utilization.jsonl"
     profile_dir: str | None = None   # jax.profiler trace output directory
     plots_dir: str | None = None     # post-run PNGs (reference: ddp_new.py:71-99)
+    # Hierarchical span tracing (obs/tracing.py): run -> stage -> seed ->
+    # epoch -> chunk/eval spans exported as Chrome-trace/Perfetto JSON.
+    # None path -> trace.json next to the metrics JSONL (per-rank suffix
+    # under multi-host). Summarize with tools/trace_report.py or open in
+    # https://ui.perfetto.dev. Distinct from profile_dir (XLA-level op
+    # profiling) — spans are pipeline-grained and always cheap.
+    trace: bool = True
+    trace_path: str | None = None
+    # Metrics registry snapshots: a {"kind": "metrics"} JSONL record at most
+    # every this-many seconds (checked at epoch boundaries; 0 disables), and
+    # a Prometheus-style textfile for external scrapers when prom_path is
+    # set (refreshed on each snapshot and at session exit).
+    snapshot_every_s: float = 60.0
+    prom_path: str | None = None
+    # Per-rank heartbeat files (obs/heartbeat.py): step/epoch/stage/last-
+    # progress JSON, atomically rewritten on training progress, throttled to
+    # one write per heartbeat_interval_s on the per-step path. Read by the
+    # watchdog (timeout messages name the stalest rank) and the consensus
+    # poison path. None dir -> <train.checkpoint_dir>_heartbeats (must be a
+    # filesystem every rank sees, like the checkpoint dir).
+    heartbeat: bool = True
+    heartbeat_dir: str | None = None
+    heartbeat_interval_s: float = 0.5
+    # Fault flight recorder (obs/flightrec.py): bounded ring of the last
+    # flightrec_capacity events on EVERY rank, dumped to
+    # <dir>/flightrec_rank<k>.json from the fault paths (watchdog fire, NaN
+    # sentinel, preemption, step exception). None dir -> next to the
+    # metrics JSONL.
+    flightrec: bool = True
+    flightrec_capacity: int = 256
+    flightrec_dir: str | None = None
 
 
 @dataclass
@@ -358,6 +397,19 @@ class Config:
             raise ValueError(
                 f"resilience.consensus_grace_s must be > 0, got "
                 f"{r.consensus_grace_s}")
+        o = self.obs
+        if o.snapshot_every_s < 0:
+            raise ValueError(
+                f"obs.snapshot_every_s must be >= 0 (0 disables periodic "
+                f"snapshots), got {o.snapshot_every_s}")
+        if o.heartbeat_interval_s < 0:
+            raise ValueError(
+                f"obs.heartbeat_interval_s must be >= 0, got "
+                f"{o.heartbeat_interval_s}")
+        if o.flightrec_capacity < 1:
+            raise ValueError(
+                f"obs.flightrec_capacity must be >= 1, got "
+                f"{o.flightrec_capacity}")
         return self
 
 

@@ -1,7 +1,16 @@
-from .metrics import MetricsLogger
+from . import flightrec, heartbeat, registry, tracing
+from .flightrec import FlightRecorder
+from .heartbeat import Heartbeat
+from .metrics import MetricsLogger, emit_run_summary
 from .monitor import ResourceMonitor, sample_devices
 from .plots import plot_metrics, plot_scores, plot_utilization
 from .profiler import StepTimer, trace
+from .registry import MetricsRegistry
+from .session import ObsSession
+from .tracing import Tracer
 
 __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
-           "trace", "plot_metrics", "plot_scores", "plot_utilization"]
+           "trace", "plot_metrics", "plot_scores", "plot_utilization",
+           "Tracer", "MetricsRegistry", "Heartbeat", "FlightRecorder",
+           "ObsSession", "emit_run_summary", "tracing", "registry",
+           "heartbeat", "flightrec"]

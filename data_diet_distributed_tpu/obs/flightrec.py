@@ -1,0 +1,134 @@
+"""Fault flight recorder: the last N events on EVERY rank, dumped on faults.
+
+The metrics JSONL is process-0 gated — when rank 3 hangs or throws, its final
+moments are invisible. The flight recorder fixes the post-mortem: a bounded
+in-memory ring on every rank records every logged event (``MetricsLogger``
+mirrors into it BEFORE its process-0 gate), plus rank-local observations the
+JSONL never carries (signal receipt, local NaN verdicts, watchdog firings).
+The fault paths — watchdog fire, NaN sentinel, preemption, step exception —
+dump the ring to ``<dir>/flightrec_rank<k>.json`` so a post-mortem has the
+last ~N events from ALL ranks, not just the one that wrote the JSONL.
+
+Recording is a deque append under a lock (~µs, safe from signal handlers and
+the watchdog's monitor thread); values are JSON-sanitized AT RECORD TIME so
+the ring never pins device arrays, and a dump can serialize even if the
+process is dying. Repeated dumps overwrite — the file always holds the most
+recent final moments. Module-level helpers no-op until a recorder is
+installed, same contract as the tracer/registry.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["FlightRecorder", "flightrec_path", "install", "uninstall",
+           "current", "record", "dump"]
+
+
+def flightrec_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"flightrec_rank{rank}.json")
+
+
+def json_safe(v):
+    """Best-effort JSON-ifier for event fields: numpy/jax scalars become
+    Python numbers, small arrays become lists, anything else falls back to
+    ``str`` — a flight-recorder entry must never be the thing that raises."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): json_safe(x) for k, x in v.items()}
+    try:
+        arr = np.asarray(v)
+    except Exception:   # noqa: BLE001
+        return str(v)
+    if arr.ndim == 0:
+        return arr.item() if arr.dtype.kind in "bifu" else str(arr)
+    if arr.size <= 32 and arr.dtype.kind in "bifu":
+        return arr.tolist()
+    return f"<array shape={arr.shape} dtype={arr.dtype}>"
+
+
+class FlightRecorder:
+    def __init__(self, directory: str = ".", rank: int = 0,
+                 capacity: int = 256):
+        self.directory = os.path.abspath(directory)
+        self.rank = rank
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+        # RLock, not Lock: record() is called from signal handlers (the
+        # preemption handler's per-rank receipt), which run on the MAIN
+        # thread between bytecodes — possibly interrupting a frame that
+        # already holds this lock (every logged event mirrors through
+        # record()). A non-reentrant lock would deadlock the rank right when
+        # it should be taking its final checkpoint.
+        self._lock = threading.RLock()
+
+    def record(self, kind: str, **fields) -> None:
+        event = {"ts": round(time.time(), 3), "kind": kind}
+        for k, v in fields.items():
+            event[k] = json_safe(v)
+        with self._lock:
+            self._ring.append(event)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str) -> str | None:
+        """Write the ring to ``flightrec_rank<k>.json`` (atomic; overwrites a
+        previous dump — latest final moments win). Returns the path, or None
+        when the write itself failed (a dying disk must not mask the original
+        fault with its own exception)."""
+        path = flightrec_path(self.directory, self.rank)
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            payload = {"rank": self.rank, "reason": str(reason)[:500],
+                       "dumped_ts": round(time.time(), 3), "pid": os.getpid(),
+                       "capacity": self.capacity, "events": self.snapshot()}
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+# --------------------------------------------------------- module-level slot
+
+_RECORDER: FlightRecorder | None = None
+
+
+def install(rec: FlightRecorder) -> FlightRecorder:
+    global _RECORDER
+    _RECORDER = rec
+    return rec
+
+
+def uninstall() -> None:
+    global _RECORDER
+    _RECORDER = None
+
+
+def current() -> FlightRecorder | None:
+    return _RECORDER
+
+
+def record(kind: str, **fields) -> None:
+    """Library-code entry: no-op until a recorder is installed."""
+    if _RECORDER is not None:
+        _RECORDER.record(kind, **fields)
+
+
+def dump(reason: str) -> str | None:
+    if _RECORDER is not None:
+        return _RECORDER.dump(reason)
+    return None

@@ -1,0 +1,173 @@
+"""Per-rank heartbeat files: WHICH rank is wedged, not just "hang".
+
+Each rank atomically rewrites one small JSON
+(``<dir>/heartbeat_rank<k>.json``: step, epoch, stage, last-progress
+timestamp, pid/host) on every unit of training progress. Readers:
+
+* the watchdog's timeout message (``Watchdog(diagnose=...)``) — a
+  ``WatchdogTimeout`` names the stalest rank and where it stopped;
+* the consensus poison path — a poison record broadcast through the
+  side-channel carries the per-rank staleness summary, so every peer's
+  ``PeerPoisoned`` (and the post-mortem) says which rank stopped making
+  progress and at what step;
+* ``tools/trace_report.py`` — reports heartbeat ages next to the trace
+  breakdown.
+
+The directory defaults to a sibling of the checkpoint dir
+(``<train.checkpoint_dir>_heartbeats``) for the same reason the poison
+side-channel lives there: it must be on a filesystem every rank sees.
+Writes are atomic (temp + rename — a reader never sees a torn JSON) and
+throttled (``min_interval_s``) so the per-step path never turns a µs loop
+iteration into an fsync storm; stage/epoch transitions bypass the throttle
+(``force=True``) so coarse progress is always current.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+__all__ = ["Heartbeat", "default_dir", "read_heartbeats", "describe_stale",
+           "install", "uninstall", "current", "beat", "describe"]
+
+
+def default_dir(checkpoint_dir: str) -> str:
+    """Sibling of the checkpoint dir, like the poison side-channel — never
+    inside it (Orbax owns the directory's contents)."""
+    return f"{checkpoint_dir}_heartbeats"
+
+
+def dir_from_cfg(cfg) -> str | None:
+    """The ONE resolution of the heartbeat directory from a Config (None =
+    heartbeats off) — shared by the ObsSession writer and the consensus
+    poison reader, so they can never drift onto different directories."""
+    if not cfg.obs.heartbeat:
+        return None
+    return cfg.obs.heartbeat_dir or default_dir(cfg.train.checkpoint_dir)
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"heartbeat_rank{rank}.json")
+
+
+class Heartbeat:
+    def __init__(self, directory: str, rank: int = 0, *,
+                 min_interval_s: float = 0.5):
+        self.directory = os.path.abspath(directory)
+        self.rank = rank
+        self.min_interval_s = float(min_interval_s)
+        self.path = heartbeat_path(self.directory, rank)
+        self._last_write = 0.0
+        self._made_dir = False
+
+    def beat(self, *, step: int | None = None, epoch: int | None = None,
+             stage: str | None = None, force: bool = False, **extra) -> bool:
+        """Rewrite this rank's heartbeat; returns whether a write happened
+        (throttled beats return False). Never raises: a full/readonly disk
+        must degrade observability, not kill training."""
+        now = time.monotonic()
+        if not force and now - self._last_write < self.min_interval_s:
+            return False
+        try:
+            if not self._made_dir:
+                os.makedirs(self.directory, exist_ok=True)
+                self._made_dir = True
+            payload = {"rank": self.rank, "ts": round(time.time(), 3),
+                       "pid": os.getpid(), "host": socket.gethostname()}
+            if step is not None:
+                payload["step"] = int(step)
+            if epoch is not None:
+                payload["epoch"] = int(epoch)
+            if stage is not None:
+                payload["stage"] = str(stage)
+            payload.update(extra)
+            tmp = f"{self.path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.path)
+            self._last_write = now
+            return True
+        except OSError:
+            return False
+
+
+def read_heartbeats(directory: str) -> dict[int, dict]:
+    """Every rank's latest heartbeat, keyed by rank. Unreadable/torn files
+    are skipped (the atomic writer makes that a transient race, not a
+    state)."""
+    out: dict[int, dict] = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("heartbeat_rank") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                rec = json.load(fh)
+            out[int(rec["rank"])] = rec
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def describe_beats(beats: dict[int, dict],
+                   now: float | None = None) -> list[str]:
+    """One human line per rank naming its last progress, stalest first —
+    THE formatting of a heartbeat record, shared by ``describe_stale``
+    (watchdog/poison messages) and ``tools/trace_report.py``, so a schema
+    change can never drift the two apart."""
+    now = time.time() if now is None else now
+    lines = []
+    for rank, rec in sorted(beats.items(),
+                            key=lambda kv: kv[1].get("ts", 0.0)):
+        age = now - float(rec.get("ts", now))
+        where = ", ".join(f"{k}={rec[k]}" for k in ("stage", "epoch", "step")
+                          if k in rec)
+        lines.append(f"rank{rank} last progress {age:.1f}s ago"
+                     + (f" ({where})" if where else ""))
+    return lines
+
+
+def describe_stale(directory: str, now: float | None = None) -> str:
+    """The per-rank summary as one line — appended to watchdog timeout
+    messages and consensus poison reasons. Empty string when no heartbeats
+    exist (single-process runs with the heartbeat disabled lose nothing)."""
+    return "; ".join(describe_beats(read_heartbeats(directory), now))
+
+
+# --------------------------------------------------------- module-level slot
+
+_HEARTBEAT: Heartbeat | None = None
+
+
+def install(hb: Heartbeat) -> Heartbeat:
+    global _HEARTBEAT
+    _HEARTBEAT = hb
+    return hb
+
+
+def uninstall() -> None:
+    global _HEARTBEAT
+    _HEARTBEAT = None
+
+
+def current() -> Heartbeat | None:
+    return _HEARTBEAT
+
+
+def beat(**kwargs) -> None:
+    """Library-code entry: no-op until a Heartbeat is installed."""
+    if _HEARTBEAT is not None:
+        _HEARTBEAT.beat(**kwargs)
+
+
+def describe() -> str:
+    """Staleness summary for the INSTALLED heartbeat's directory (the
+    watchdog's ``diagnose`` hook); empty when none is installed."""
+    if _HEARTBEAT is None:
+        return ""
+    return describe_stale(_HEARTBEAT.directory)

@@ -3,16 +3,41 @@
 
 One line per event, process-0 gated, flushed eagerly so a crashed run still has its
 history. The schema is flat JSON so anything (pandas, jq, TensorBoard import) can
-consume it.
+consume it; ``tools/validate_metrics.py`` checks a stream against the known
+event kinds and their required fields.
+
+Robustness contract: ``log`` must never crash a run. Fields are serialized with
+a safe default encoder (jax/numpy scalars become Python numbers, arrays become
+short lists or a shape summary — callers routinely pass whatever the step
+returned), and the parent directory of ``path`` is created on open instead of
+crashing when the configured workdir does not exist yet. Every event is also
+mirrored into the fault flight recorder (``obs/flightrec.py``) BEFORE the
+process-0 gate, so every rank's ring holds its own final moments even though
+only rank 0 writes the JSONL.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, IO
 
 import jax
+
+from . import flightrec
+
+
+def _json_default(v: Any):
+    """``json.dumps`` fallback for the field types training code actually
+    passes: numpy/jax scalars, small arrays, and (last resort) repr."""
+    item = getattr(v, "item", None)
+    if item is not None and getattr(v, "ndim", 1) == 0:
+        try:
+            return item()
+        except Exception:   # noqa: BLE001 — fall through to the summary path
+            pass
+    return flightrec.json_safe(v)
 
 
 class MetricsLogger:
@@ -20,14 +45,20 @@ class MetricsLogger:
         self.echo = echo
         self._fh: IO[str] | None = None
         if path and jax.process_index() == 0:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
             self._fh = open(path, "a", buffering=1)
 
     def log(self, kind: str, **fields: Any) -> None:
+        # Every rank's flight recorder sees every event this rank produced —
+        # the ring is the non-primary ranks' only event history.
+        flightrec.record(kind, **fields)
         if jax.process_index() != 0:
             return
         record = {"ts": round(time.time(), 3), "kind": kind, **fields}
         if self._fh is not None:
-            self._fh.write(json.dumps(record) + "\n")
+            self._fh.write(json.dumps(record, default=_json_default) + "\n")
         if self.echo:
             body = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
             print(f"[{kind}] {body}", flush=True)
@@ -61,6 +92,32 @@ class MetricsLogger:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+def emit_run_summary(logger: MetricsLogger, *, wall_s: float, exit_class: str,
+                     command: str | None = None,
+                     final: dict[str, Any] | None = None,
+                     registry=None) -> dict[str, Any]:
+    """The TERMINAL event of a run — emitted as the last JSONL line.
+
+    Carries total wall time, the per-stage seconds breakdown (from the
+    metrics registry's stage histograms, keyed by the stage-manifest stage
+    names), the run's final metrics, and the exit classification
+    (``ok`` / ``preempted`` / ``retriable`` / ``fatal:<Type>`` — the same
+    vocabulary as ``bench.classify_exit``). Returns the record so callers
+    (``bench.py``) read the summarized numbers instead of re-deriving them."""
+    record: dict[str, Any] = {"wall_s": round(wall_s, 3),
+                              "exit_class": exit_class}
+    if command is not None:
+        record["command"] = command
+    if registry is not None:
+        stage_s = registry.stage_seconds()
+        if stage_s:
+            record["stage_s"] = stage_s
+    if final:
+        record["final"] = {k: v for k, v in final.items() if v is not None}
+    logger.log("run_summary", **record)
+    return record
 
 
 def _fmt(v: Any) -> str:

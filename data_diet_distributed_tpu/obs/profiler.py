@@ -24,8 +24,25 @@ def trace(profile_dir: str | None):
         jax.profiler.stop_trace()
 
 
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile over an UNSORTED sample (NaN when empty).
+    One definition shared by ``StepTimer``, the metrics registry's streaming
+    histograms, and ``tools/trace_report.py`` — tail-latency numbers from
+    every layer are computed the same way."""
+    if not values:
+        return float("nan")
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
 class StepTimer:
-    """Wall-clock per-step timing with warmup discard (compile steps excluded)."""
+    """Wall-clock per-step timing with warmup discard (compile steps excluded).
+
+    Beyond the historical ``mean``, reports tail quantiles (``p50``/``p95``/
+    ``max``) and the retained sample ``count`` — a throughput mean hides
+    exactly the stalls (GC, checkpoint barrier, relay hiccup) the tail
+    exposes. ``summary()`` is the dict ``bench.py`` embeds in the BENCH JSON."""
 
     def __init__(self, warmup: int = 1):
         self.warmup = warmup
@@ -40,3 +57,28 @@ class StepTimer:
     @property
     def mean(self) -> float:
         return sum(self.times) / len(self.times) if self.times else float("nan")
+
+    @property
+    def count(self) -> int:
+        return len(self.times)
+
+    @property
+    def p50(self) -> float:
+        return percentile(self.times, 0.50)
+
+    @property
+    def p95(self) -> float:
+        return percentile(self.times, 0.95)
+
+    @property
+    def max(self) -> float:
+        return max(self.times) if self.times else float("nan")
+
+    def summary(self, digits: int = 6) -> dict:
+        # NaN (no retained samples) becomes None: the summary lands in JSON
+        # artifacts, and bare NaN is not valid JSON (PR-1's parity-tool rule).
+        def _r(v: float):
+            return round(v, digits) if v == v else None
+
+        return {"mean": _r(self.mean), "p50": _r(self.p50),
+                "p95": _r(self.p95), "max": _r(self.max), "count": self.count}

@@ -1,0 +1,260 @@
+"""Metrics registry: counters, gauges, streaming histograms, two exporters.
+
+One process-wide place every layer reports its numbers to — throughput,
+step/dispatch latency, checkpoint save/restore time, score-computation time,
+per-stage wall — snapshotted (a) into the metrics JSONL stream as periodic
+``{"kind": "metrics", ...}`` records and (b) into a Prometheus-style textfile
+(node-exporter textfile-collector format) so an external scraper can watch a
+run without parsing JSONL.
+
+Histograms reuse the ``StepTimer`` percentile math (``obs/profiler.py``) over
+a BOUNDED reservoir: running count/sum/max are exact; quantiles come from the
+first ``reservoir`` samples plus uniform replacement afterwards (Vitter's
+algorithm R), so a million-step run costs a fixed few KB per histogram.
+
+Like the tracer, the module-level helpers (``inc``/``set_gauge``/``observe``/
+``timed``) are no-ops until a registry is installed — library code threads
+them unconditionally; un-instrumented callers pay one global ``is None``
+check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import re
+import threading
+import time
+
+from .profiler import percentile
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram", "install",
+           "uninstall", "current", "inc", "set_gauge", "observe", "timed",
+           "maybe_snapshot"]
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming summary: exact count/sum/max, reservoir-sampled quantiles."""
+
+    def __init__(self, reservoir: int = 2048, seed: int = 0):
+        self.count = 0
+        self.total = 0.0
+        self.max = float("-inf")
+        self._cap = reservoir
+        self._sample: list[float] = []
+        # Private PRNG: reservoir replacement must not perturb (or be
+        # perturbed by) anyone else's use of the global random state.
+        self._rng = random.Random(seed)
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if v > self.max:
+            self.max = v
+        if len(self._sample) < self._cap:
+            self._sample.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self._cap:
+                self._sample[j] = v
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else float("nan")
+
+    def quantile(self, q: float) -> float:
+        return percentile(self._sample, q)
+
+    def summary(self, digits: int = 6) -> dict:
+        def _r(v: float):
+            return round(v, digits) if v == v and v not in (
+                float("inf"), float("-inf")) else None
+
+        return {"count": self.count, "mean": _r(self.mean),
+                "p50": _r(self.quantile(0.50)), "p95": _r(self.quantile(0.95)),
+                "max": _r(self.max if self.count else float("nan")),
+                "sum": _r(self.total)}
+
+
+def _prom_name(prefix: str, name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", f"{prefix}_{name}")
+
+
+class MetricsRegistry:
+    """Create-or-get named instruments; snapshot/export the lot."""
+
+    def __init__(self, prefix: str = "ddt", prom_path: str | None = None):
+        self.prefix = prefix
+        # Where snapshots also land as a Prometheus textfile (None = off).
+        # Set rank-aware by the installer (ObsSession gates it to process 0,
+        # like the JSONL): every rank overwriting one shared file would make
+        # the scraped metrics flap between ranks.
+        self.prom_path = prom_path
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+        self._last_snapshot = 0.0
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram())
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.histogram(name).record(time.perf_counter() - t0)
+
+    # ----------------------------------------------------------- exporters
+
+    def snapshot(self) -> dict:
+        """Nested snapshot: ``{"counters": {...}, "gauges": {...},
+        "histograms": {name: summary}}`` — the shape the JSONL ``metrics``
+        record and ``run_summary`` embed."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: round(g.value, 6)
+                           for k, g in self._gauges.items()},
+                "histograms": {k: h.summary()
+                               for k, h in self._histograms.items()},
+            }
+
+    def stage_seconds(self) -> dict[str, float]:
+        """Per-stage wall seconds (histograms named ``stage_s:<stage>``,
+        recorded by the pipeline's stage spans) — the ``run_summary`` event's
+        per-stage breakdown, keyed by the SAME stage names the stage manifest
+        uses (``score``, ``retrain:<tag>``, ``dense:final``)."""
+        with self._lock:
+            return {k.split(":", 1)[1]: round(h.total, 3)
+                    for k, h in self._histograms.items()
+                    if k.startswith("stage_s:")}
+
+    def to_prometheus(self) -> str:
+        """node-exporter textfile-collector format. Histogram quantiles use
+        the summary-type convention (``name{quantile="0.5"}``)."""
+        lines: list[str] = []
+        snap = self.snapshot()
+        for k, v in snap["counters"].items():
+            n = _prom_name(self.prefix, k)
+            lines += [f"# TYPE {n} counter", f"{n} {v}"]
+        for k, v in snap["gauges"].items():
+            n = _prom_name(self.prefix, k)
+            lines += [f"# TYPE {n} gauge", f"{n} {v}"]
+        for k, s in snap["histograms"].items():
+            n = _prom_name(self.prefix, k)
+            lines.append(f"# TYPE {n} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95")):
+                if s[key] is not None:
+                    lines.append(f'{n}{{quantile="{q}"}} {s[key]}')
+            lines += [f"{n}_sum {s['sum'] or 0}", f"{n}_count {s['count']}"]
+            if s["max"] is not None:
+                lines += [f"# TYPE {n}_max gauge", f"{n}_max {s['max']}"]
+        return "\n".join(lines) + "\n"
+
+    def write_prometheus(self, path: str) -> None:
+        """Atomic (temp + rename): a scraper must never read a half-written
+        textfile."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            fh.write(self.to_prometheus())
+        os.replace(tmp, path)
+
+    def snapshot_event(self, logger) -> None:
+        """One ``{"kind": "metrics"}`` JSONL record + ``prom_path`` textfile
+        refresh. ``logger`` is a MetricsLogger (process-0 gated there)."""
+        self._last_snapshot = time.monotonic()
+        logger.log("metrics", **self.snapshot())
+        if self.prom_path:
+            self.write_prometheus(self.prom_path)
+
+    def maybe_snapshot(self, logger, every_s: float) -> bool:
+        """Cadenced snapshot — called from cheap periodic hooks (the epoch
+        boundary); emits only when ``every_s`` has elapsed since the last."""
+        if every_s <= 0 or time.monotonic() - self._last_snapshot < every_s:
+            return False
+        self.snapshot_event(logger)
+        return True
+
+
+# --------------------------------------------------------- module-level slot
+
+_REGISTRY: MetricsRegistry | None = None
+
+
+def install(registry: MetricsRegistry) -> MetricsRegistry:
+    global _REGISTRY
+    _REGISTRY = registry
+    return registry
+
+
+def uninstall() -> None:
+    global _REGISTRY
+    _REGISTRY = None
+
+
+def current() -> MetricsRegistry | None:
+    return _REGISTRY
+
+
+def inc(name: str, n: int = 1) -> None:
+    if _REGISTRY is not None:
+        _REGISTRY.counter(name).inc(n)
+
+
+def set_gauge(name: str, v: float) -> None:
+    if _REGISTRY is not None:
+        _REGISTRY.gauge(name).set(v)
+
+
+def observe(name: str, v: float) -> None:
+    if _REGISTRY is not None:
+        _REGISTRY.histogram(name).record(v)
+
+
+def timed(name: str):
+    """Histogram-timed context (inert null context when uninstalled)."""
+    if _REGISTRY is None:
+        return contextlib.nullcontext()
+    return _REGISTRY.timed(name)
+
+
+def maybe_snapshot(logger, every_s: float) -> None:
+    if _REGISTRY is not None:
+        _REGISTRY.maybe_snapshot(logger, every_s)

@@ -1,0 +1,88 @@
+"""ObsSession: build + install the run-wide observability instruments.
+
+One context manager constructs the four instruments from ``cfg.obs`` —
+tracer (Chrome-trace spans), metrics registry, per-rank heartbeat, fault
+flight recorder — installs them into their module-level slots (where
+library code reaches them with no plumbed-through arguments), and tears
+them down at exit:
+
+* exit with an exception → the flight recorder dumps (the ring's final
+  events include whatever the fault paths recorded on the way up);
+* the registry's final state lands in the Prometheus textfile
+  (``obs.prom_path``) if one is configured;
+* the tracer is closed (terminating the JSON array) and every slot is
+  cleared so a later session (tests run many) starts clean.
+
+Entered AFTER multi-host init (it needs ``jax.process_index()`` for the
+per-rank file names). Used by the CLI; tests install instruments directly
+when they want just one.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import flightrec, heartbeat, registry, tracing
+
+DEFAULT_TRACE_NAME = "trace.json"
+
+
+def _workdir(cfg) -> str:
+    """The run's output directory: where the metrics JSONL goes (the trace
+    and flight-recorder dumps live NEXT TO it, per the obs contract).
+    ``obs.metrics_path=null`` is legal (MetricsLogger accepts None) — the
+    other artifacts then default to the current directory."""
+    return os.path.dirname(cfg.obs.metrics_path or "") or "."
+
+
+class ObsSession:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.tracer: tracing.Tracer | None = None
+        self.registry: registry.MetricsRegistry | None = None
+        self.heartbeat: heartbeat.Heartbeat | None = None
+        self.recorder: flightrec.FlightRecorder | None = None
+
+    def __enter__(self) -> "ObsSession":
+        import jax
+        cfg = self.cfg
+        rank = jax.process_index()
+        if cfg.obs.trace:
+            base = cfg.obs.trace_path or os.path.join(_workdir(cfg),
+                                                      DEFAULT_TRACE_NAME)
+            self.tracer = tracing.install(
+                tracing.Tracer(tracing.trace_path_for(base, rank), rank=rank))
+        # Prometheus textfile is rank-0 only (like the JSONL): N ranks
+        # overwriting one shared file would flap the scraped values.
+        self.registry = registry.install(registry.MetricsRegistry(
+            prom_path=cfg.obs.prom_path if rank == 0 else None))
+        hb_dir = heartbeat.dir_from_cfg(cfg)
+        if hb_dir is not None:
+            self.heartbeat = heartbeat.install(heartbeat.Heartbeat(
+                hb_dir, rank, min_interval_s=cfg.obs.heartbeat_interval_s))
+        if cfg.obs.flightrec:
+            fr_dir = cfg.obs.flightrec_dir or _workdir(cfg)
+            self.recorder = flightrec.install(flightrec.FlightRecorder(
+                fr_dir, rank, capacity=cfg.obs.flightrec_capacity))
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and self.recorder is not None:
+            # Preempted is a CLEAN exit (its own dump already happened in the
+            # preemption path with the better reason); everything else is a
+            # fault whose final moments belong on disk.
+            from ..resilience.preemption import Preempted
+            if not isinstance(exc, Preempted):
+                flightrec.record("fault", fault="exception",
+                                 error=repr(exc)[:300])
+                flightrec.dump(f"exception:{type(exc).__name__}")
+        if self.registry is not None and self.registry.prom_path:
+            try:
+                self.registry.write_prometheus(self.registry.prom_path)
+            except OSError:
+                pass   # a dying disk must not mask the run's own outcome
+        flightrec.uninstall()
+        heartbeat.uninstall()
+        registry.uninstall()
+        tracing.uninstall()   # closes the trace file
+        return False

@@ -1,0 +1,226 @@
+"""Hierarchical trace spans exported as Chrome-trace / Perfetto JSON.
+
+The metrics JSONL answers "what happened"; this answers "where did the time
+go". Every pipeline layer wraps its unit of work in a ``span`` — nested
+run → stage → seed → epoch → chunk/eval — and each span becomes one
+``trace_events`` complete event (``"ph": "X"``), so ``chrome://tracing`` or
+https://ui.perfetto.dev renders the whole pipeline as a flame chart,
+per-chunk dispatch timing from the chunked engine included.
+
+Format notes (the parts that make crashed runs still readable):
+
+* The file is a bare JSON array of events — the Chrome trace format
+  explicitly tolerates a MISSING terminating ``]``, so events are streamed
+  (one line each, flushed eagerly) and a killed run's trace opens fine.
+  ``close()`` writes the terminator, making the file plain valid JSON too.
+* ``ts``/``dur`` are microseconds. ``ts`` is wall-clock so traces from
+  different ranks/hosts align when loaded together; ``dur`` is measured on
+  the monotonic clock so spans never go negative under clock steps.
+* ``pid`` is the process index (rank) and ``tid`` a small per-thread ordinal,
+  named via metadata events — synchronous spans on one tid nest by timestamp
+  containment, which is exactly the hierarchy the callers express.
+
+The module-level ``span()``/``instant()`` are no-ops (one global ``is None``
+check) until a ``Tracer`` is installed, so library code threads them
+unconditionally at zero cost to un-instrumented callers (tests, bench loops).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+from typing import IO
+
+__all__ = ["Tracer", "span", "instant", "install", "uninstall", "current",
+           "trace_path_for"]
+
+
+def trace_path_for(base: str, rank: int) -> str:
+    """Per-rank trace file path: rank 0 keeps ``base`` (the common
+    single-process case stays ``trace.json``); other ranks get a
+    ``_rank<k>`` suffix so multi-host runs never clobber each other."""
+    if rank == 0:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}_rank{rank}{ext or '.json'}"
+
+
+class Tracer:
+    """Streaming Chrome-trace writer. Thread-safe; cheap enough to leave on
+    (one dict + one ``write`` per span; spans are chunk/epoch-grained, never
+    per-device-op — ``jax.profiler`` owns that granularity)."""
+
+    def __init__(self, path: str, *, rank: int = 0, enabled: bool = True):
+        self.path = path
+        self.rank = rank
+        self.enabled = enabled
+        self._fh: IO[str] | None = None
+        # RLock: _tid() emits the thread-name metadata event while already
+        # holding the lock (first span on a new thread).
+        self._lock = threading.RLock()
+        self._tids: dict[int, int] = {}
+        # Anchor: wall-clock ts derived from one (wall, monotonic) pair so
+        # every event's ts is consistent within the run even if the wall
+        # clock steps mid-run.
+        self._wall0 = time.time()
+        self._mono0 = time.perf_counter()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _now_us(self) -> float:
+        return (self._wall0 + (time.perf_counter() - self._mono0)) * 1e6
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
+                name = threading.current_thread().name
+                self._emit({"ph": "M", "name": "thread_name", "pid": self.rank,
+                            "tid": tid, "args": {"name": name}})
+        return tid
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "w", buffering=1)
+                self._fh.write("[\n")
+                self._fh.write(json.dumps({
+                    "ph": "M", "name": "process_name", "pid": self.rank,
+                    "tid": 0, "args": {
+                        "name": f"rank{self.rank}@{socket.gethostname()}"},
+                }) + ",\n")
+            self._fh.write(json.dumps(event, default=str) + ",\n")
+
+    # ------------------------------------------------------------------ API
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "span", **args):
+        """One complete event around the body. ``args`` land in the event's
+        ``args`` (visible in the trace viewer's detail pane)."""
+        if not self.enabled:
+            yield
+            return
+        ts = self._now_us()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = (time.perf_counter() - t0) * 1e6
+            event = {"name": name, "cat": cat or "span", "ph": "X",
+                     "ts": round(ts, 1), "dur": round(dur, 1),
+                     "pid": self.rank, "tid": self._tid()}
+            if args:
+                event["args"] = args
+            self._emit(event)
+
+    def complete(self, name: str, start_mono: float, cat: str = "span",
+                 **args) -> None:
+        """Emit a finished span from a caller-held ``time.perf_counter()``
+        start — for long bodies (an epoch) where wrapping the whole block in
+        a ``with`` would obscure the control flow, and where an abandoned
+        span (preemption raising mid-epoch) should simply not appear."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        dur = (now - start_mono) * 1e6
+        event = {"name": name, "cat": cat, "ph": "X",
+                 "ts": round(self._now_us() - dur, 1), "dur": round(dur, 1),
+                 "pid": self.rank, "tid": self._tid()}
+        if args:
+            event["args"] = args
+        self._emit(event)
+
+    def instant(self, name: str, cat: str = "event", **args) -> None:
+        """A zero-duration marker (``ph: "i"``) — faults, signals, beats."""
+        if not self.enabled:
+            return
+        event = {"name": name, "cat": cat, "ph": "i", "s": "t",
+                 "ts": round(self._now_us(), 1), "pid": self.rank,
+                 "tid": self._tid()}
+        if args:
+            event["args"] = args
+        self._emit(event)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                # Terminate the array: the streamed trailing comma is legal
+                # inside the tolerant readers, but a proper ']' makes the
+                # file strict JSON for everything else. '{}' absorbs the
+                # trailing comma.
+                self._fh.write("{}]\n")
+                self._fh.close()
+                self._fh = None
+
+
+# --------------------------------------------------------- module-level slot
+
+_TRACER: Tracer | None = None
+_NULL = contextlib.nullcontext()
+
+
+def install(tracer: Tracer) -> Tracer:
+    global _TRACER
+    _TRACER = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    global _TRACER
+    if _TRACER is not None:
+        _TRACER.close()
+    _TRACER = None
+
+
+def current() -> Tracer | None:
+    return _TRACER
+
+
+def span(name: str, cat: str = "span", **args):
+    """The library-code entry: a span on the installed tracer, or an inert
+    null context when none is installed (one global check, no allocation)."""
+    if _TRACER is None:
+        return _NULL
+    return _TRACER.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "event", **args) -> None:
+    if _TRACER is not None:
+        _TRACER.instant(name, cat, **args)
+
+
+def complete(name: str, start_mono: float, cat: str = "span", **args) -> None:
+    if _TRACER is not None:
+        _TRACER.complete(name, start_mono, cat, **args)
+
+
+def read_trace(path: str) -> list[dict]:
+    """Load a trace written by ``Tracer`` — including one from a crashed run
+    (missing ``]``): falls back to line-wise parsing of the streamed events.
+    Shared by ``tools/trace_report.py`` and the tests."""
+    with open(path) as fh:
+        content = fh.read()
+    try:
+        return [e for e in json.loads(content) if e]
+    except json.JSONDecodeError:
+        events = []
+        for line in content.splitlines():
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]", "{}]"):
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue   # partial last line from the crash
+            if ev:
+                events.append(ev)
+        return events

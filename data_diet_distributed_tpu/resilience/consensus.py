@@ -174,7 +174,8 @@ class Consensus:
     """
 
     def __init__(self, channel_dir: str, *, poll_every: int = 1,
-                 grace_s: float = 15.0, logger=None, tag: str = ""):
+                 grace_s: float = 15.0, logger=None, tag: str = "",
+                 heartbeat_dir: str | None = None):
         import jax
         self.rank = jax.process_index()
         self.world = jax.process_count()
@@ -182,6 +183,7 @@ class Consensus:
         self.grace_s = float(grace_s)
         self.logger = logger
         self.tag = tag
+        self.heartbeat_dir = heartbeat_dir
         self.channel = SideChannel(channel_dir, self.rank)
         self._preempt_latch = False
         self.channel.open()
@@ -197,9 +199,11 @@ class Consensus:
             return None
         channel_dir = (cfg.resilience.sidechannel_dir
                        or f"{cfg.train.checkpoint_dir}_sidechannel")
+        from ..obs import heartbeat
+        hb_dir = heartbeat.dir_from_cfg(cfg)
         return cls(channel_dir, poll_every=cfg.resilience.consensus_poll_steps,
                    grace_s=cfg.resilience.consensus_grace_s, logger=logger,
-                   tag=tag)
+                   tag=tag, heartbeat_dir=hb_dir)
 
     def _log(self, event: str, **fields) -> None:
         if self.logger is not None:
@@ -241,9 +245,22 @@ class Consensus:
 
     def poison(self, reason: str) -> None:
         """Broadcast a poison value (watchdog ``on_fire`` hook; safe to call
-        from the monitor thread — no jax, no collectives)."""
+        from the monitor thread — no jax, no collectives). The reason is
+        enriched with the per-rank heartbeat staleness summary when
+        heartbeats are on, so every peer's ``PeerPoisoned`` — and the
+        post-mortem — names WHICH rank stopped making progress, not just
+        that someone hung."""
+        reason = str(reason)
+        if self.heartbeat_dir is not None:
+            try:
+                from ..obs.heartbeat import describe_stale
+                stale = describe_stale(self.heartbeat_dir)
+            except Exception:   # noqa: BLE001 — diagnosis never blocks poison
+                stale = ""
+            if stale:
+                reason = f"{reason} | heartbeats: {stale}"
         self.channel.poison(reason)
-        self._log("poison", reason=str(reason)[:300])
+        self._log("poison", reason=reason[:300])
 
     def peer_exception(self) -> PeerPoisoned | None:
         """A ``PeerPoisoned`` describing the first peer poison record, or

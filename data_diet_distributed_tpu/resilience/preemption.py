@@ -84,6 +84,16 @@ class PreemptionHandler:
         self._seen.add(signum)
         self._signame = signal.Signals(signum).name
         self._requested.set()
+        # Per-rank receipt in the flight recorder: the JSONL "preempted"
+        # event is rank-0 gated and only lands after the loop's next poll —
+        # the ring records WHEN each rank actually got the signal. (Handlers
+        # run in the main bytecode loop; a deque append + try guard is safe
+        # here, and forensics must never break signal handling.)
+        try:
+            from ..obs import flightrec
+            flightrec.record("signal", signal=self._signame)
+        except Exception:   # noqa: BLE001
+            pass
 
     def __enter__(self) -> "PreemptionHandler":
         if not self.enabled:

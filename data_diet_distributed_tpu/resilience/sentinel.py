@@ -58,6 +58,14 @@ class LossSentinel:
         if not self.enabled:
             return
         bad = not math.isfinite(value)
+        if bad:
+            # Per-rank forensics BEFORE any consensus collective: the JSONL
+            # fault event is rank-0 gated and post-agreement, but a
+            # post-mortem needs to know which rank's LOCAL loss was the
+            # non-finite one (flight-recorder ring; no-op when uninstalled).
+            from ..obs import flightrec
+            flightrec.record("divergence_local", tag=tag, epoch=epoch,
+                             loss=str(value))
         if agree is not None:
             agreed_bad = agree(bad)
             if agreed_bad and not bad:

@@ -76,7 +76,7 @@ class Watchdog:
 
     def __init__(self, timeout_s: float, label: str = "section", *,
                  on_fire=None, peer_check=None, escalate_s: float | None = None,
-                 escalate_code: int = 69):
+                 escalate_code: int = 69, diagnose=None):
         """Multi-host consensus wiring (all optional; single-host default is
         unchanged):
 
@@ -92,6 +92,11 @@ class Watchdog:
           with ``escalate_code``: the main thread is stuck in a native call
           the raising handler cannot reach (a wedged collective), and a
           bounded retriable exit beats an unbounded hang. None = never.
+        * ``diagnose()`` — extra context appended to the timeout message
+          (the training loop passes the per-rank heartbeat staleness
+          summary, so a ``WatchdogTimeout`` names WHICH rank stopped making
+          progress and where — ``obs/heartbeat.describe``). Best-effort: a
+          raising diagnose never masks the timeout itself.
         """
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
@@ -99,6 +104,7 @@ class Watchdog:
         self.label = label
         self._on_fire = on_fire
         self._peer_check = peer_check
+        self._diagnose = diagnose
         self._escalate_s = escalate_s
         self._escalate_code = escalate_code
         self._poll_s = max(0.05, min(1.0, self.timeout_s / 10.0))
@@ -126,9 +132,16 @@ class Watchdog:
         self._deadline = float("inf")
 
     def _timeout_error(self) -> WatchdogTimeout:
-        return WatchdogTimeout(
-            f"{self.label}: no heartbeat within {self.timeout_s:g}s "
-            "(silent hang converted to a retriable failure)")
+        msg = (f"{self.label}: no heartbeat within {self.timeout_s:g}s "
+               "(silent hang converted to a retriable failure)")
+        if self._diagnose is not None:
+            try:
+                extra = self._diagnose()
+            except Exception:   # noqa: BLE001 — diagnosis never masks the timeout
+                extra = ""
+            if extra:
+                msg += f" | {extra}"
+        return WatchdogTimeout(msg)
 
     def _on_signal(self, signum, frame):
         raise self._pending or self._timeout_error()
@@ -147,6 +160,18 @@ class Watchdog:
             self._fired = True
             self._pending = peer_exc if peer_exc is not None \
                 else self._timeout_error()
+            # Flight-recorder dump AT FIRE TIME, from this thread: the main
+            # thread may be wedged in a native call and never run another
+            # line, so this is the one guaranteed chance to persist the
+            # rank's final moments (no-op when no recorder is installed).
+            try:
+                from ..obs import flightrec
+                flightrec.record(
+                    "fault", fault="peer_poisoned" if peer_exc else "hang",
+                    label=self.label, error=str(self._pending)[:300])
+                flightrec.dump(f"watchdog:{self.label}")
+            except Exception:   # noqa: BLE001 — forensics never kill the guard
+                pass
             if expired and self._on_fire is not None:
                 # OWN expiry only (a peer's poison is already broadcast):
                 # poison best-effort before the raise, from this thread —

@@ -34,7 +34,9 @@ from ..data.datasets import ArrayDataset
 from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              maybe_resident, num_batches)
 from ..models import create_model_from_cfg
-from ..obs import MetricsLogger
+from ..obs import MetricsLogger, flightrec, tracing
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import registry as obs_registry
 from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
 from ..pruning import select_indices
@@ -101,6 +103,21 @@ def resolve_chunk_steps(cfg: Config, steps_per_epoch: int, train_resident,
     return max(1, min(int(k), steps_per_epoch, MAX_CHUNK_STEPS))
 
 
+@contextlib.contextmanager
+def _stage_span(name: str):
+    """A pipeline-stage trace span + its ``stage_s:<name>`` registry
+    histogram — named EXACTLY like the stage manifest's stages (``score``,
+    ``prune:<tag>``, ``retrain:<tag>``, ``dense:final``) so the trace
+    breakdown, the ``run_summary`` per-stage seconds, and the resume manifest
+    all speak one vocabulary."""
+    t0 = time.perf_counter()
+    with tracing.span(name, cat="stage"):
+        try:
+            yield
+        finally:
+            obs_registry.observe(f"stage_s:{name}", time.perf_counter() - t0)
+
+
 @dataclass
 class FitResult:
     state: TrainState
@@ -114,6 +131,28 @@ class FitResult:
             if "test_accuracy" in rec:
                 return rec["test_accuracy"]
         return None
+
+    def throughput_summary(self) -> dict[str, Any]:
+        """Steady-state throughput + epoch-wall quantiles (epoch 0 is
+        compile/upload warmup, discarded when more epochs exist). The ONE
+        derivation of a fit's headline numbers: the CLI's ``run_summary``
+        terminal event and ``bench.py``'s BENCH JSON both read this instead
+        of re-deriving from raw history."""
+        from ..obs.profiler import StepTimer
+        timer = StepTimer(warmup=1 if len(self.history) > 1 else 0)
+        for rec in self.history:
+            timer.record(rec["epoch_s"])
+        steady = self.history[1:] if len(self.history) > 1 else self.history
+        eps = (sum(h["examples_per_s"] for h in steady) / len(steady)
+               if steady else None)
+        out: dict[str, Any] = {"epochs": len(self.history),
+                               "chunk_steps": self.chunk_steps,
+                               "epoch_s": timer.summary()}
+        if eps is not None:
+            out["examples_per_s"] = round(eps, 1)
+        if self.final_test_accuracy is not None:
+            out["final_test_accuracy"] = self.final_test_accuracy
+        return out
 
 
 def _image_dtype(cfg: Config):
@@ -338,12 +377,17 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
             wd_timeout *= chunk_steps
         watchdog = (Watchdog(wd_timeout,
                              label=f"{tag} step loop",
+                             # A timeout names which rank last made progress
+                             # (per-rank heartbeat files; "" when disabled).
+                             diagnose=obs_heartbeat.describe,
                              **(consensus.watchdog_kwargs()
                                 if consensus is not None else {}))
                     if wd_timeout else None)
         preempt = PreemptionHandler(enabled=cfg.resilience.preemption)
         sentinel = LossSentinel(enabled=cfg.resilience.nan_check)
-        with preempt, (watchdog or contextlib.nullcontext()):
+        with preempt, (watchdog or contextlib.nullcontext()), \
+                tracing.span("fit", cat="fit", tag=tag,
+                             epochs=cfg.train.num_epochs):
             _fit_epochs(cfg, train_ds, test_ds, model, state, train_step,
                         eval_step, sharder, logger, ckpt, start_epoch,
                         batch_size, tag, result, saved_steps, train_resident,
@@ -386,6 +430,9 @@ def _preempt_exit(preempt, ckpt, state, logger, tag, epoch, steps_per_epoch,
         ckpt.all_steps()   # durability barrier: the async save must land
     logger.log("preempted", tag=tag, signal=preempt.signame, step=step,
                epoch=epoch, durable_step=durable)
+    # The ring now ends with the signal receipt + this preempted event —
+    # dump every rank's final moments before the clean exit.
+    flightrec.dump(f"preempted:{preempt.signame}")
     raise Preempted(preempt.signame, step=step, epoch=epoch,
                     durable_step=durable)
 
@@ -444,6 +491,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
     step_offset = int(state.step) - start_epoch * steps_per_epoch
     for epoch in range(start_epoch, cfg.train.num_epochs):
         epoch_t0 = time.perf_counter()
+        obs_heartbeat.beat(epoch=epoch, stage=tag, force=True)
         shuffle = cfg.data.shuffle_each_epoch
         # Device scalars accumulate un-synced (async dispatch); host conversion
         # happens once per epoch below, in a single device_get — per-scalar
@@ -463,9 +511,17 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 if watchdog is not None:
                     watchdog.beat()
                 unit = epoch * steps_per_epoch + done
+                obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
                 inject.fire("step", epoch=epoch, step=unit)
-                state, metrics = _dispatch_chunk(chunk_fn, state,
-                                                 train_resident, idx, mask)
+                # The span measures the host-side DISPATCH (permutation
+                # upload + enqueue; blocks only when the device queue is
+                # full) — per-chunk dispatch timing in the trace is the
+                # chunked engine's own metric.
+                with tracing.span("chunk", cat="chunk", step=unit,
+                                  k=int(idx.shape[0])), \
+                        obs_registry.timed("chunk_dispatch_s"):
+                    state, metrics = _dispatch_chunk(chunk_fn, state,
+                                                     train_resident, idx, mask)
                 step_metrics.append(metrics)
                 prev_done, done = done, done + idx.shape[0]
                 if (done // cfg.train.log_every_steps
@@ -493,13 +549,19 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 if watchdog is not None:
                     watchdog.beat()
                 unit = epoch * steps_per_epoch + i
+                # Throttled internally (obs.heartbeat_interval_s): per-step
+                # progress without a per-step fsync.
+                obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
                 if consensus is not None:
                     # A peer's poison (its watchdog fired) aborts THIS rank
                     # here, before it enters a collective the poisoned peer
                     # will never join — PeerPoisoned, not an unbounded hang.
                     consensus.check_peers(unit)
                 inject.fire("step", epoch=epoch, step=unit)
+                t_disp = time.perf_counter()
                 state, metrics = train_step(state, batch)
+                obs_registry.observe("step_dispatch_s",
+                                     time.perf_counter() - t_disp)
                 step_metrics.append(metrics)
                 # Streaming mode: bound dispatch runahead so queued
                 # host-uploaded batches can't pile up in HBM (resident batches
@@ -559,12 +621,20 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 logger.fault("divergence", tag=tag, epoch=epoch,
                              step=int(state.step),
                              loss=str(record["train_loss"]))
+                # Every rank dumps its ring (the sentinel recorded the
+                # rank-LOCAL verdict; the mirrored fault event above is the
+                # ring's final entry) — the post-mortem for a NaN needs the
+                # loss trajectory from all ranks, not just process 0.
+                flightrec.dump(f"divergence:epoch{epoch}")
                 raise
         if test_ds is not None and ((epoch + 1) % cfg.train.eval_every == 0
                                     or epoch + 1 == cfg.train.num_epochs):
-            ev = evaluate(model, state, test_ds, sharder, cfg.data.eval_batch_size,
-                          eval_step, resident=test_resident,
-                          chunk_steps=chunk_steps)
+            with tracing.span("eval", cat="eval", epoch=epoch, tag=tag), \
+                    obs_registry.timed("eval_s"):
+                ev = evaluate(model, state, test_ds, sharder,
+                              cfg.data.eval_batch_size,
+                              eval_step, resident=test_resident,
+                              chunk_steps=chunk_steps)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
             if watchdog is not None:
@@ -575,6 +645,15 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 watchdog.beat()
         logger.log("epoch", tag=tag, **record)
         result.history.append(record)
+        # Registry: throughput/latency instruments every layer shares, plus a
+        # cadenced {"kind": "metrics"} snapshot into the JSONL (and the
+        # Prometheus textfile, refreshed on the same cadence).
+        obs_registry.inc("epochs")
+        obs_registry.inc("steps", steps_per_epoch)
+        obs_registry.observe("epoch_s", epoch_s)
+        obs_registry.set_gauge("examples_per_s", record["examples_per_s"])
+        tracing.complete("epoch", epoch_t0, cat="epoch", epoch=epoch, tag=tag)
+        obs_registry.maybe_snapshot(logger, cfg.obs.snapshot_every_s)
         save_now = ckpt is not None and (
             (epoch + 1) % cfg.train.checkpoint_every == 0
             or epoch + 1 == cfg.train.num_epochs)
@@ -688,9 +767,14 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
             _refuse_if_multihost(err, attempt)
             if attempt > cfg.train.auto_resume_retries or checkpoint_dir is None:
                 raise
-            logger.fault(
-                "hang" if isinstance(err, WatchdogTimeout) else "step_exception",
-                attempt=attempt, error=repr(err)[:300])
+            fault = ("hang" if isinstance(err, WatchdogTimeout)
+                     else "step_exception")
+            logger.fault(fault, attempt=attempt, error=repr(err)[:300])
+            # Final moments BEFORE the retry re-enters fit and the ring
+            # starts filling with the new attempt's events. (The watchdog
+            # already dumped at fire time from its monitor thread; this
+            # overwrite adds the fault event itself to the ring.)
+            flightrec.dump(f"{fault}:attempt{attempt}")
             resume_step = _latest_durable()
             logger.log("recovery", cause="exception", attempt=attempt,
                        retries_left=cfg.train.auto_resume_retries - attempt,
@@ -746,17 +830,21 @@ def score_variables_for_seeds(cfg: Config, train_ds: ArrayDataset, *,
     if cfg.score.pretrain_epochs > 0:
         shared_resident = _train_resident(cfg, train_ds, mesh, sharder)
     for s in seeds:
-        if cfg.score.pretrain_epochs > 0:
-            res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
-                      num_epochs=cfg.score.pretrain_epochs, seed=int(s),
-                      tag=f"score_pretrain_seed{s}", train_resident=shared_resident)
-            out.append(res.state.variables)
-        else:
-            model = create_model_from_cfg(cfg)
-            variables = jax.jit(model.init, static_argnames=("train",))(
-                jax.random.key(int(s)),
-                np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
-            out.append(replicate(variables, mesh))
+        with tracing.span("seed", cat="seed", seed=int(s),
+                          role="score_pretrain"):
+            if cfg.score.pretrain_epochs > 0:
+                res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder,
+                          logger=logger, num_epochs=cfg.score.pretrain_epochs,
+                          seed=int(s), tag=f"score_pretrain_seed{s}",
+                          train_resident=shared_resident)
+                out.append(res.state.variables)
+            else:
+                model = create_model_from_cfg(cfg)
+                variables = jax.jit(model.init, static_argnames=("train",))(
+                    jax.random.key(int(s)),
+                    np.zeros((1, *train_ds.images.shape[1:]), np.float32),
+                    train=False)
+                out.append(replicate(variables, mesh))
     return out
 
 
@@ -850,10 +938,11 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
                 flush()
                 tracker.update(to_obs(np.concatenate(chunks)[:n]))
 
-            fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
-                num_epochs=cfg.score.pretrain_epochs, seed=int(s),
-                tag=f"{method}_seed{s}", train_resident=shared_resident,
-                epoch_hook=hook)
+            with tracing.span("seed", cat="seed", seed=int(s), role=method):
+                fit(cfg, train_ds, None, mesh=mesh, sharder=sharder,
+                    logger=logger, num_epochs=cfg.score.pretrain_epochs,
+                    seed=int(s), tag=f"{method}_seed{s}",
+                    train_resident=shared_resident, epoch_hook=hook)
             rec = {"seed": int(s), "epochs": tracker.updates}
             if method == "forgetting":
                 rec.update(never_learned=int((~tracker.learned).sum()),
@@ -920,6 +1009,17 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
     exits cleanly at the next seed boundary (``Preempted``/75), and
     re-invocation pretrains + scores only the incomplete seeds.
     """
+    with _stage_span("score"):
+        scores, timings = _compute_scores(cfg, train_ds, mesh=mesh,
+                                          sharder=sharder, logger=logger,
+                                          stages=stages)
+    obs_registry.observe("score_s", timings["score_s"])
+    obs_registry.observe("score_pretrain_s", timings["pretrain_s"])
+    return scores, timings
+
+
+def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
+                    logger, stages=None) -> tuple[np.ndarray, dict[str, float]]:
     t0 = time.perf_counter()
     if cfg.score.scores_npz:
         scores = load_scores_npz(cfg.score.scores_npz, train_ds,
@@ -968,6 +1068,7 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                 # f64 arrays — loaded from partials — in the same order, so
                 # interrupted and uninterrupted runs are bit-identical.
                 total[:] += seed_scores
+                tracing.instant("seed_scored", cat="seed", seed=todo[k])
                 if partials is None:
                     return
                 partials.save(todo[k], seed_scores)
@@ -1147,33 +1248,37 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         logger.stage(stage, "skipped", sparsity=float(sparsity),
                      final_test_accuracy=summary.get("final_test_accuracy"))
         return summary
-    kept = select_indices(scores, train_ds.indices, sparsity,
-                          keep=cfg.prune.keep, seed=cfg.train.seed,
-                          labels=train_ds.labels,
-                          class_balance=cfg.prune.class_balance)
-    # Provenance: scores reused from an artifact did NOT come from this cfg's
-    # score.method — record where they came from instead.
-    loaded_from = score_t.get("loaded_from")
-    method = f"reused:{loaded_from}" if loaded_from else cfg.score.method
-    if is_primary():   # every process holds the full scores; one writes
-        # Atomic (temp + rename): a crash mid-write must never leave a
-        # truncated npz that a later score.scores_npz reuse trusts.
-        atomic_savez(scores_npz_path(ckpt_dir), scores=scores,
-                     indices=train_ds.indices, kept=kept, keep=cfg.prune.keep,
-                     class_balance=cfg.prune.class_balance, method=method)
-    score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
-    prune_rec = dict(n_total=len(train_ds), n_kept=len(kept),
-                     score_s=round(score_s, 3),
-                     pretrain_s=round(pretrain_s, 3))
-    passes = score_t.get("passes", _score_passes(cfg))
-    if not loaded_from and passes and score_s > 0:
-        # An npz load in milliseconds is not a scoring rate — omit rather
-        # than log an absurd number (likewise a fully-resumed scoring pass).
-        prune_rec["score_examples_per_s"] = len(train_ds) * passes / score_s
-    logger.log("prune", **prune_rec)
-    if stages is not None:
-        stages.complete(f"prune:{tag}", n_kept=int(len(kept)),
-                        sparsity=float(sparsity))
+    with _stage_span(f"prune:{tag}"):
+        kept = select_indices(scores, train_ds.indices, sparsity,
+                              keep=cfg.prune.keep, seed=cfg.train.seed,
+                              labels=train_ds.labels,
+                              class_balance=cfg.prune.class_balance)
+        # Provenance: scores reused from an artifact did NOT come from this
+        # cfg's score.method — record where they came from instead.
+        loaded_from = score_t.get("loaded_from")
+        method = f"reused:{loaded_from}" if loaded_from else cfg.score.method
+        if is_primary():   # every process holds the full scores; one writes
+            # Atomic (temp + rename): a crash mid-write must never leave a
+            # truncated npz that a later score.scores_npz reuse trusts.
+            atomic_savez(scores_npz_path(ckpt_dir), scores=scores,
+                         indices=train_ds.indices, kept=kept,
+                         keep=cfg.prune.keep,
+                         class_balance=cfg.prune.class_balance, method=method)
+        score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
+        prune_rec = dict(n_total=len(train_ds), n_kept=len(kept),
+                         score_s=round(score_s, 3),
+                         pretrain_s=round(pretrain_s, 3))
+        passes = score_t.get("passes", _score_passes(cfg))
+        if not loaded_from and passes and score_s > 0:
+            # An npz load in milliseconds is not a scoring rate — omit rather
+            # than log an absurd number (likewise a fully-resumed scoring
+            # pass).
+            prune_rec["score_examples_per_s"] = (len(train_ds) * passes
+                                                 / score_s)
+        logger.log("prune", **prune_rec)
+        if stages is not None:
+            stages.complete(f"prune:{tag}", n_kept=int(len(kept)),
+                            sparsity=float(sparsity))
     cfg_retrain = cfg
     if stages is not None and stages.started(stage) and not cfg.train.resume:
         # This exact stage was interrupted mid-retrain: re-enter from its own
@@ -1184,9 +1289,10 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         logger.stage(stage, "resuming", ckpt_dir=ckpt_dir)
     if stages is not None:
         stages.start(stage, ckpt_dir=ckpt_dir)
-    res = fit_with_recovery(cfg_retrain, train_ds.subset(kept), test_ds,
-                            mesh=mesh, sharder=sharder, logger=logger,
-                            checkpoint_dir=ckpt_dir, tag=tag)
+    with _stage_span(stage):
+        res = fit_with_recovery(cfg_retrain, train_ds.subset(kept), test_ds,
+                                mesh=mesh, sharder=sharder, logger=logger,
+                                checkpoint_dir=ckpt_dir, tag=tag)
     summary = {
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
         "sparsity": float(sparsity), "score_method": method,
@@ -1304,10 +1410,11 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         cfg_dense.train.resume = True
         logger.stage(stage, "resuming", ckpt_dir=cfg.train.checkpoint_dir)
     stages.start(stage, ckpt_dir=cfg.train.checkpoint_dir)
-    res = fit_with_recovery(cfg_dense, train_ds, test_ds, mesh=mesh,
-                            sharder=sharder, logger=logger,
-                            checkpoint_dir=cfg.train.checkpoint_dir,
-                            tag="final")
+    with _stage_span(stage):
+        res = fit_with_recovery(cfg_dense, train_ds, test_ds, mesh=mesh,
+                                sharder=sharder, logger=logger,
+                                checkpoint_dir=cfg.train.checkpoint_dir,
+                                tag="final")
     summary = {
         "dataset": cfg.data.dataset, "n_train": len(train_ds),
         "sparsity": cfg.prune.sparsity, "score_method": cfg.score.method,

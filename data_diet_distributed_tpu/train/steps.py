@@ -43,8 +43,25 @@ import jax
 import jax.numpy as jnp
 
 from ..data.pipeline import gather_resident_batch
+from ..obs import registry as obs_registry
 from ..ops.scores import cross_entropy
 from .state import TrainState
+
+
+def _counted(fn, name: str):
+    """Host-side dispatch counter around a jitted step: one registry counter
+    increment per CALL (outside the traced program — a Python side effect
+    inside it would run once at trace time). No-op-cheap when no registry is
+    installed; never touches the computation, so the chunked engine's
+    bit-exactness contract is untouched."""
+    counter = f"dispatches_{name}"
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        obs_registry.inc(counter)
+        return fn(*args, **kwargs)
+
+    return dispatch
 
 
 def _train_step_math(model, augment, state: TrainState, batch):
@@ -93,7 +110,7 @@ def make_train_step(model, augment: tuple[int, bool, int] | None = None):
     def train_step(state: TrainState, batch):
         return _train_step_math(model, augment, state, batch)
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return _counted(jax.jit(train_step, donate_argnums=(0,)), "train_step")
 
 
 @functools.cache
@@ -142,7 +159,7 @@ def make_train_chunk(model, augment: tuple[int, bool, int] | None = None,
         # the identical step program repeated, so chunked == per-step bitwise.
         return jax.lax.scan(body, state, (idx, mask), unroll=True)
 
-    return jax.jit(train_chunk, donate_argnums=(0,))
+    return _counted(jax.jit(train_chunk, donate_argnums=(0,)), "train_chunk")
 
 
 @functools.cache
@@ -163,7 +180,7 @@ def make_eval_chunk(model, out_sharding=None):
         _, out = jax.lax.scan(body, 0, (idx, mask), unroll=True)
         return out
 
-    return jax.jit(eval_chunk)
+    return _counted(jax.jit(eval_chunk), "eval_chunk")
 
 
 @functools.cache
@@ -171,4 +188,4 @@ def make_eval_step(model):
     def eval_step(state: TrainState, batch):
         return _eval_step_math(model, state, batch)
 
-    return jax.jit(eval_step)
+    return _counted(jax.jit(eval_step), "eval_step")

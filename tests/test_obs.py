@@ -176,6 +176,98 @@ def test_step_timer_and_trace(tmp_path):
         pass
 
 
+def test_monitor_sampling_with_fake_cpu_counters(tmp_path, monkeypatch):
+    """Deterministic sampling: /proc/stat counters are faked so the computed
+    cpu_pct is exact (50% busy), and the devices list is present on every
+    record — the monitor's math, not the host's load, is under test."""
+    from data_diet_distributed_tpu.obs import monitor as mon_mod
+
+    ticks = iter([(1000.0, 500.0), (1100.0, 550.0), (1200.0, 600.0),
+                  (1300.0, 650.0), (1400.0, 700.0), (1500.0, 750.0)])
+    last = [(1000.0, 500.0)]
+
+    def fake_cpu_times():
+        try:
+            last[0] = next(ticks)
+        except StopIteration:
+            total, idle = last[0]
+            last[0] = (total + 100.0, idle + 50.0)
+        return last[0]
+
+    monkeypatch.setattr(mon_mod, "_cpu_times", fake_cpu_times)
+    path = str(tmp_path / "util.jsonl")
+    with mon_mod.ResourceMonitor(path, interval_s=0.03, probe_duty=False):
+        time.sleep(0.25)
+    recs = [json.loads(l) for l in open(path).read().splitlines() if l]
+    assert recs, "monitor wrote no samples"
+    for r in recs:
+        # 50 idle of 100 total per interval -> exactly 50% busy.
+        assert r["cpu_pct"] == 50.0
+        assert isinstance(r["devices"], list) and r["devices"]
+        assert "ts" in r
+
+
+def test_monitor_survives_duty_probe_failure(tmp_path, monkeypatch):
+    """A probe backend that cannot initialize (or dies mid-run) must degrade
+    to CPU/HBM-only sampling, never kill the monitor thread."""
+    from data_diet_distributed_tpu.obs import monitor as mon_mod
+
+    class ExplodingProbes:
+        def __init__(self):
+            raise RuntimeError("no device for you")
+
+    monkeypatch.setattr(mon_mod, "_DutyProbes", ExplodingProbes)
+    path = str(tmp_path / "util.jsonl")
+    with mon_mod.ResourceMonitor(path, interval_s=0.03, probe_duty=True):
+        time.sleep(0.2)
+    recs = [json.loads(l) for l in open(path).read().splitlines() if l]
+    assert recs, "probe failure must not stop CPU sampling"
+    assert all("duty_cycle" not in r for r in recs)
+
+
+def test_sample_devices_shape():
+    import jax
+    from data_diet_distributed_tpu.obs import sample_devices
+    out = sample_devices()
+    assert len(out) == len(jax.local_devices())
+    for d in out:
+        assert set(d) == {"device", "bytes_in_use", "bytes_limit",
+                          "peak_bytes_in_use"}
+
+
+@requires_mpl
+def test_plots_smoke_all_renderers_to_tmpdir(tmp_path):
+    """One Agg-backend smoke over every renderer: utilization (with and
+    without duty/limits), metrics curves, and the score histogram, all
+    writing non-empty PNGs into a fresh tmpdir."""
+    import numpy as np
+    from data_diet_distributed_tpu.obs import plot_scores
+
+    upath = str(tmp_path / "util.jsonl")
+    with open(upath, "w") as fh:
+        for i in range(4):
+            fh.write(json.dumps({
+                "ts": 10.0 + i, "cpu_pct": 25.0,
+                "devices": [{"device": "cpu:0", "bytes_in_use": 2**20,
+                             "bytes_limit": None}],   # no limit -> GiB axis
+            }) + "\n")
+    mpath = str(tmp_path / "metrics.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    for e in range(3):
+        logger.log("epoch", epoch=e, train_loss=1.0 - 0.1 * e,
+                   examples_per_s=50.0, test_accuracy=0.5)
+    logger.close()
+    npz = str(tmp_path / "s_scores.npz")
+    np.savez(npz, scores=np.linspace(0, 1, 100).astype(np.float32),
+             indices=np.arange(100))
+    out_dir = str(tmp_path / "plots")
+    written = (plot_utilization(upath, out_dir) + plot_metrics(mpath, out_dir)
+               + plot_scores(npz, out_dir))
+    assert len(written) >= 5
+    for p in written:
+        assert os.path.getsize(p) > 0
+
+
 @requires_mpl
 def test_plot_scores_class_balanced_skips_global_cut(tmp_path):
     """Class-balanced pruning uses per-class thresholds — the plot must not

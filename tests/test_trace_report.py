@@ -1,0 +1,138 @@
+"""tools/trace_report.py + the end-to-end acceptance path: a CPU-lane CLI
+``run`` produces a Chrome-trace JSON whose report carries the per-stage
+breakdown under the SAME stage names as the stage manifest, per-rank
+heartbeat files, and a run_summary-terminated metrics stream."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", REPO / "tools" / "trace_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tr():
+    return _load_tool()
+
+
+def _span(name, cat, ts, dur_us, pid=0, **args):
+    e = {"name": name, "cat": cat, "ph": "X", "ts": ts, "dur": dur_us,
+         "pid": pid, "tid": 0}
+    if args:
+        e["args"] = args
+    return e
+
+
+def test_summarize_stages_epochs_chunks_gaps(tr):
+    events = [
+        _span("score", "stage", 0.0, 2_000_000),
+        _span("retrain:final", "stage", 2_000_000, 5_000_000),
+        _span("epoch", "epoch", 2_000_000, 2_000_000, tag="final", epoch=0),
+        _span("epoch", "epoch", 5_500_000, 1_000_000, tag="final", epoch=1),
+        _span("chunk", "chunk", 2_000_000, 400_000, step=0, k=4),
+        _span("chunk", "chunk", 2_500_000, 100_000, step=4, k=4),
+    ]
+    rep = tr.summarize(events, gap_threshold_s=1.0)
+    assert rep["stages"]["score"]["total_s"] == 2.0
+    assert rep["stages"]["retrain:final"]["total_s"] == 5.0
+    assert rep["epochs"]["final"]["count"] == 2
+    assert rep["epochs"]["final"]["max_s"] == 2.0
+    # Slowest chunk first, with its args surfaced.
+    assert rep["slowest_chunks"][0]["dur_s"] == 0.4
+    assert rep["slowest_chunks"][0]["step"] == 0
+    # The 2.6 s -> 5.5 s interval where nothing completed is a progress gap
+    # (endpoints at 2.0, 2.4, 2.6, 4.0, 6.5, 7.0 -> largest silent stretch).
+    assert rep["gaps"], "expected at least one reported gap"
+    assert rep["gaps"][0]["gap_s"] >= 1.0
+    text = tr.render(rep)
+    assert "retrain:final" in text and "per-stage breakdown" in text
+
+
+def test_render_includes_heartbeats(tr):
+    rep = tr.summarize([_span("x", "stage", 0.0, 1000.0)])
+    beats = {0: {"rank": 0, "ts": 100.0, "step": 7, "stage": "final"}}
+    text = tr.render(rep, heartbeats=beats, now=103.5)
+    assert "rank0 last progress 3.5s ago" in text and "step=7" in text
+
+
+def test_cli_run_trace_report_end_to_end(tmp_path, mesh8):
+    """Acceptance: CLI run -> trace.json summarized by the tool with stage
+    names matching the stage manifest; heartbeats written; terminal
+    run_summary; metrics stream valid."""
+    from data_diet_distributed_tpu import cli
+    rc = cli.main([
+        "run", "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=1",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt", "score.pretrain_epochs=0",
+        "score.batch_size=64", "prune.sparsity=0.5",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "obs.heartbeat_interval_s=0"])
+    assert rc == 0
+
+    # Stage names in the manifest == stage names in the trace report.
+    manifest = json.load(open(tmp_path / "ckpt_stages.json"))
+    manifest_stages = set(manifest["stages"])
+    assert {"score", "prune:final", "retrain:final"} <= manifest_stages
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(tmp_path / "trace.json"),
+         "--heartbeats", str(tmp_path / "ckpt_heartbeats"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    report = json.loads(proc.stdout)
+    assert manifest_stages <= set(report["stages"])
+    assert report["stages"]["retrain:final"]["total_s"] > 0
+    assert report["epochs"], "per-epoch breakdown missing"
+    assert report["heartbeats"]["0"]["stage"] == "final"
+
+    # Terminal event + stream validity (the validator is its own tool).
+    lines = [l for l in open(tmp_path / "metrics.jsonl") if l.strip()]
+    last = json.loads(lines[-1])
+    assert last["kind"] == "run_summary" and last["exit_class"] == "ok"
+    assert set(last["stage_s"]) == manifest_stages
+    vproc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "validate_metrics.py"),
+         "--expect-terminal", str(tmp_path / "metrics.jsonl")],
+        capture_output=True, text=True, timeout=60)
+    assert vproc.returncode == 0, vproc.stderr[-800:]
+
+
+def test_trace_report_merges_rank_traces(tr, tmp_path):
+    for rank in (0, 1):
+        path = tmp_path / ("trace.json" if rank == 0
+                           else f"trace_rank{rank}.json")
+        with open(path, "w") as fh:
+            fh.write("[\n")
+            fh.write(json.dumps(_span("epoch", "epoch", 0.0, 1_000_000,
+                                      pid=rank, tag="final")) + ",\n")
+    events = []
+    from data_diet_distributed_tpu.obs.tracing import read_trace
+    for p in sorted(tmp_path.iterdir()):
+        events.extend(read_trace(str(p)))
+    rep = tr.summarize(events)
+    assert rep["ranks"] == [0, 1]
+    assert rep["epochs"]["final"]["count"] == 2
+
+
+def test_trace_report_empty_trace_errors(tmp_path):
+    empty = tmp_path / "t.json"
+    empty.write_text("[\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"), str(empty)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1

@@ -1,0 +1,149 @@
+"""tools/validate_metrics.py: the JSONL stream's schema, pinned — both on
+hand-built streams (unit) and on streams a real training run and a real
+injected-fault run actually produce (the tier-1 "validate what we emit"
+check)."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger, emit_run_summary
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.train import loop as loop_mod
+from data_diet_distributed_tpu.train.loop import fit_with_recovery
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_metrics", REPO / "tools" / "validate_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def vm():
+    return _load_validator()
+
+
+def test_valid_stream_passes(vm):
+    lines = [
+        json.dumps({"ts": 1.0, "kind": "epoch", "epoch": 0,
+                    "train_loss": 0.5}),
+        json.dumps({"ts": 2.0, "kind": "fault", "fault": "hang"}),
+        json.dumps({"ts": 3.0, "kind": "stage", "stage": "score",
+                    "status": "done"}),
+        json.dumps({"ts": 4.0, "kind": "run_summary", "wall_s": 3.0,
+                    "exit_class": "ok"}),
+    ]
+    assert vm.validate_lines(lines, expect_terminal=True) == []
+
+
+def test_violations_reported(vm):
+    lines = [
+        "not json at all",
+        json.dumps({"ts": 1.0, "kind": "made_up_kind"}),
+        json.dumps({"kind": "fault"}),                       # no ts, no fault
+        json.dumps({"ts": 2.0, "kind": "stage", "stage": "x",
+                    "status": "bogus"}),
+        json.dumps({"ts": 3.0, "epoch": 1}),                 # no kind
+        json.dumps({"ts": 4.0, "kind": "epoch", "epoch": 0,
+                    "train_loss": 0.1}),
+    ]
+    problems = vm.validate_lines(lines, where="s", expect_terminal=True)
+    text = "\n".join(problems)
+    assert "s:1: not valid JSON" in text
+    assert "unknown kind 'made_up_kind'" in text
+    assert "missing numeric 'ts'" in text
+    assert "missing required field 'fault'" in text
+    assert "status 'bogus'" in text
+    assert "missing 'kind'" in text
+    assert "expected the 'run_summary' terminal event" in text
+
+
+def test_partial_trailing_line_tolerated(vm):
+    lines = [json.dumps({"ts": 1.0, "kind": "epoch", "epoch": 0,
+                         "train_loss": 0.5}),
+             '{"ts": 2.0, "kind": "trunca']   # killed mid-write
+    assert vm.validate_lines(lines) == []
+
+
+def test_empty_stream_is_a_violation(vm):
+    assert vm.validate_lines([]) != []
+
+
+def test_real_training_stream_validates(vm, tmp_path, mesh8, tiny_ds):
+    """The stream an actual run_datadiet pipeline writes — stage events,
+    prune, summary, epochs, run_summary terminal — passes its own validator."""
+    train_ds, test_ds = tiny_ds
+
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+        "prune.sparsity=0.5"])
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    loop_mod.run_datadiet(cfg, logger)
+    emit_run_summary(logger, wall_s=1.0, exit_class="ok", command="run")
+    logger.close()
+    problems = vm.validate_file(str(tmp_path / "metrics.jsonl"),
+                                expect_terminal=True)
+    assert problems == [], problems
+    # The stream really exercised the structured kinds, not a trivial pass.
+    kinds = {json.loads(l)["kind"]
+             for l in open(tmp_path / "metrics.jsonl") if l.strip()}
+    assert {"stage", "prune", "summary", "epoch", "run_summary"} <= kinds
+
+
+def test_fault_stream_validates(vm, tmp_path, mesh8, tiny_ds):
+    """Fault/recovery events (injected NaN divergence) satisfy the schema."""
+    train_ds, _ = tiny_ds
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "train.num_epochs=2", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0"])
+    inject.activate(inject.FaultPlan(nan_loss_at_epoch=1))
+    try:
+        fit_with_recovery(cfg, train_ds, None,
+                          checkpoint_dir=f"{tmp_path}/ckpt", mesh=mesh8,
+                          logger=MetricsLogger(cfg.obs.metrics_path,
+                                               echo=False))
+    finally:
+        inject.deactivate()
+    problems = vm.validate_file(str(tmp_path / "metrics.jsonl"))
+    assert problems == [], problems
+    kinds = {json.loads(l)["kind"]
+             for l in open(tmp_path / "metrics.jsonl") if l.strip()}
+    assert {"fault", "recovery"} <= kinds
+
+
+def test_cli_entrypoint_exit_codes(vm, tmp_path):
+    import subprocess
+    import sys
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps({"ts": 1.0, "kind": "epoch", "epoch": 0,
+                                "train_loss": 0.5}) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ts": 1.0, "kind": "nope"}\n{"x": 1}\n')
+    ok = subprocess.run([sys.executable,
+                         str(REPO / "tools" / "validate_metrics.py"),
+                         str(good)], capture_output=True, text=True)
+    assert ok.returncode == 0 and "OK" in ok.stdout
+    fail = subprocess.run([sys.executable,
+                           str(REPO / "tools" / "validate_metrics.py"),
+                           str(bad)], capture_output=True, text=True)
+    assert fail.returncode == 1 and "unknown kind" in fail.stderr

@@ -1,0 +1,174 @@
+"""Summarize a Chrome-trace JSON written by ``obs/tracing.py``.
+
+What a human asks after a run (or a crash): where did the time go, per stage
+and per epoch; which chunks were slow; were there gaps where nothing made
+progress; and how fresh is each rank's heartbeat. One command answers all
+four without opening a trace viewer::
+
+    python tools/trace_report.py <workdir>/trace.json
+    python tools/trace_report.py trace.json trace_rank1.json   # merged view
+    python tools/trace_report.py trace.json --heartbeats ./ckpt_heartbeats
+    python tools/trace_report.py trace.json --json             # machine-readable
+
+Reads crashed-run traces too (the streamed format tolerates a missing
+terminating ``]`` — ``obs.tracing.read_trace``). The per-stage breakdown uses
+the SAME stage names as the resilience stage manifest (``score``,
+``prune:<tag>``, ``retrain:<tag>``, ``dense:final``), so a trace summary and
+a resume manifest describe the run in one vocabulary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from data_diet_distributed_tpu.obs.heartbeat import (describe_beats,  # noqa: E402
+                                                     read_heartbeats)
+from data_diet_distributed_tpu.obs.profiler import percentile  # noqa: E402
+from data_diet_distributed_tpu.obs.tracing import read_trace  # noqa: E402
+
+#: Inter-event gaps shorter than this are loop bookkeeping, not stalls.
+DEFAULT_GAP_S = 1.0
+
+
+def _spans(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _dur_summary(durs_us: list[float]) -> dict:
+    s = [d / 1e6 for d in durs_us]
+    return {"count": len(s), "total_s": round(sum(s), 3),
+            "mean_s": round(sum(s) / len(s), 4) if s else None,
+            "p50_s": round(percentile(s, 0.50), 4) if s else None,
+            "p95_s": round(percentile(s, 0.95), 4) if s else None,
+            "max_s": round(max(s), 4) if s else None}
+
+
+def summarize(events: list[dict], *, top_chunks: int = 5,
+              gap_threshold_s: float = DEFAULT_GAP_S) -> dict:
+    """The report dict: per-stage totals, per-epoch stats, slowest chunks,
+    largest inter-event gaps (the trace-side heartbeat-gap signal: an
+    interval where NO span ended is an interval where nothing completed)."""
+    spans = _spans(events)
+    by_cat: dict[str, list[dict]] = {}
+    for e in spans:
+        by_cat.setdefault(e.get("cat", "span"), []).append(e)
+
+    stages = {}
+    for e in by_cat.get("stage", []):
+        stages.setdefault(e["name"], []).append(e["dur"])
+    stage_report = {name: _dur_summary(durs)
+                    for name, durs in sorted(stages.items())}
+
+    epochs: dict[str, list[float]] = {}
+    for e in by_cat.get("epoch", []):
+        tag = (e.get("args") or {}).get("tag", "")
+        epochs.setdefault(tag, []).append(e["dur"])
+    epoch_report = {tag: _dur_summary(durs)
+                    for tag, durs in sorted(epochs.items())}
+
+    chunk_spans = sorted(by_cat.get("chunk", []), key=lambda e: -e["dur"])
+    slowest = [{"dur_s": round(e["dur"] / 1e6, 4), "pid": e.get("pid"),
+                **(e.get("args") or {})} for e in chunk_spans[:top_chunks]]
+    chunk_report = (_dur_summary([e["dur"] for e in chunk_spans])
+                    if chunk_spans else None)
+
+    # Progress gaps: sort every event endpoint; a long interval with no
+    # endpoint means nothing finished — a stall, a hang, or legitimate
+    # long-compile. Only X/i events carry timestamps worth ordering.
+    points = sorted(e["ts"] + e.get("dur", 0.0) for e in events
+                    if e.get("ph") in ("X", "i") and "ts" in e)
+    gaps = []
+    for a, b in zip(points, points[1:]):
+        gap_s = (b - a) / 1e6
+        if gap_s >= gap_threshold_s:
+            gaps.append({"gap_s": round(gap_s, 3),
+                         "at_s": round((a - points[0]) / 1e6, 3)})
+    gaps.sort(key=lambda g: -g["gap_s"])
+
+    total_s = (points[-1] - points[0]) / 1e6 if len(points) > 1 else 0.0
+    return {"events": len(events), "spans": len(spans),
+            "trace_total_s": round(total_s, 3), "stages": stage_report,
+            "epochs": epoch_report, "chunks": chunk_report,
+            "slowest_chunks": slowest, "gaps": gaps[:5],
+            "ranks": sorted({e.get("pid", 0) for e in spans})}
+
+
+def _fmt_summary(name: str, s: dict, width: int = 24) -> str:
+    return (f"  {name:<{width}} total {s['total_s']:>9.3f}s  "
+            f"n={s['count']:<4d} mean {s['mean_s']}s  "
+            f"p95 {s['p95_s']}s  max {s['max_s']}s")
+
+
+def render(report: dict, heartbeats: dict[int, dict] | None = None,
+           now: float | None = None) -> str:
+    lines = [f"trace: {report['events']} events, {report['spans']} spans, "
+             f"{report['trace_total_s']}s span, "
+             f"ranks {report['ranks']}"]
+    if report["stages"]:
+        lines.append("per-stage breakdown:")
+        lines += [_fmt_summary(n, s) for n, s in report["stages"].items()]
+    if report["epochs"]:
+        lines.append("per-epoch (by fit tag):")
+        lines += [_fmt_summary(t or "<untagged>", s)
+                  for t, s in report["epochs"].items()]
+    if report["chunks"]:
+        lines.append("chunk dispatches:")
+        lines.append(_fmt_summary("all chunks", report["chunks"]))
+        for c in report["slowest_chunks"]:
+            where = ", ".join(f"{k}={v}" for k, v in c.items() if k != "dur_s")
+            lines.append(f"    slow chunk {c['dur_s']}s ({where})")
+    if report["gaps"]:
+        lines.append("largest progress gaps (no event completed):")
+        for g in report["gaps"]:
+            lines.append(f"  {g['gap_s']}s at t+{g['at_s']}s")
+    if heartbeats is not None:
+        if heartbeats:
+            # Same formatting as WatchdogTimeout messages / poison reasons
+            # (obs/heartbeat.describe_beats) — one vocabulary everywhere.
+            lines.append("heartbeats:")
+            lines += [f"  {line}" for line in describe_beats(heartbeats, now)]
+        else:
+            lines.append("heartbeats: none found")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Summarize obs/tracing.py Chrome-trace JSON")
+    parser.add_argument("trace", nargs="+", help="trace JSON file(s); "
+                        "multiple files (per-rank traces) are merged")
+    parser.add_argument("--heartbeats", default=None,
+                        help="heartbeat directory to report rank ages from")
+    parser.add_argument("--top-chunks", type=int, default=5)
+    parser.add_argument("--gap-threshold", type=float, default=DEFAULT_GAP_S,
+                        help="report inter-event gaps at least this long (s)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as one JSON object")
+    args = parser.parse_args(argv)
+
+    events: list[dict] = []
+    for path in args.trace:
+        events.extend(read_trace(path))
+    if not events:
+        print(f"no events in {args.trace}", file=sys.stderr)
+        return 1
+    report = summarize(events, top_chunks=args.top_chunks,
+                       gap_threshold_s=args.gap_threshold)
+    beats = (read_heartbeats(args.heartbeats)
+             if args.heartbeats is not None else None)
+    if args.json:
+        if beats is not None:
+            report["heartbeats"] = beats
+        print(json.dumps(report))
+    else:
+        print(render(report, heartbeats=beats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

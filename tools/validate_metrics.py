@@ -1,0 +1,141 @@
+"""Schema validator for the metrics JSONL stream.
+
+The JSONL is the run's public API — recovery tooling, ``trace_report``-style
+post-mortems, plots, and the tests all filter on ``kind`` and trust per-kind
+required fields. This validator pins that contract: every line parses as
+JSON, every record carries ``ts`` and a ``kind`` from the known set, and the
+structured event kinds (fault / stage / consensus / recovery / preempted /
+run_summary / metrics) carry their required fields. A final-line check
+(``--expect-terminal``) asserts the stream ends with the ``run_summary``
+terminal event the CLI emits.
+
+Usage::
+
+    python tools/validate_metrics.py <metrics.jsonl> [...]
+    python tools/validate_metrics.py --expect-terminal metrics.jsonl
+
+Exit 0 = valid; 1 = violations (each printed as ``path:line: problem``).
+Library use: ``validate_lines`` / ``validate_file`` return the violation list
+(tier-1 tests run them over the streams the test runs produce).
+
+A trailing PARTIAL line (a run killed mid-write) is tolerated by design —
+every other consumer of the stream tolerates it too (``obs/plots.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: Every event kind the framework emits (grep `logger.log(` /
+#: `logger.fault|stage|consensus`). An unknown kind is a violation: either a
+#: typo in new instrumentation, or a new kind that must be added HERE so the
+#: stream's consumers know about it.
+KNOWN_KINDS = frozenset({
+    # training / pipeline progress
+    "train_step", "train_chunked", "epoch", "resume", "summary", "prune",
+    "sweep_scored", "sweep_done", "scores_saved", "scores_loaded",
+    "score_seeds_resumed", "score_ckpt_loaded", "forgetting_seed_done",
+    "aum_seed_done",
+    # resilience
+    "fault", "recovery", "recovery_refused", "preempted", "stage",
+    "consensus",
+    # observability layer
+    "metrics", "run_summary",
+})
+
+#: kind -> fields every record of that kind must carry.
+REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
+    "fault": ("fault",),
+    "stage": ("stage", "status"),
+    "consensus": ("event", "rank"),
+    "recovery": ("cause",),
+    "preempted": ("signal",),
+    "epoch": ("epoch", "train_loss"),
+    "run_summary": ("wall_s", "exit_class"),
+    "metrics": ("counters", "gauges", "histograms"),
+}
+
+#: Valid statuses for stage events (resilience/stages.py vocabulary).
+STAGE_STATUSES = frozenset({"started", "done", "skipped", "reset", "invalid",
+                            "resuming"})
+
+
+def validate_lines(lines, *, where: str = "<stream>",
+                   expect_terminal: bool = False) -> list[str]:
+    """Violations as ``where:lineno: problem`` strings (empty = valid)."""
+    problems: list[str] = []
+    last_kind = None
+    records = 0
+    lines = list(lines)
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines):
+                continue   # partial trailing line from a killed run: tolerated
+            problems.append(f"{where}:{i}: not valid JSON")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"{where}:{i}: not a JSON object")
+            continue
+        records += 1
+        kind = rec.get("kind")
+        if "ts" not in rec or not isinstance(rec["ts"], (int, float)):
+            problems.append(f"{where}:{i}: missing numeric 'ts'")
+        if kind is None:
+            problems.append(f"{where}:{i}: missing 'kind'")
+            continue
+        if kind not in KNOWN_KINDS:
+            problems.append(f"{where}:{i}: unknown kind {kind!r}")
+            continue
+        last_kind = kind
+        for field in REQUIRED_FIELDS.get(kind, ()):
+            if field not in rec:
+                problems.append(
+                    f"{where}:{i}: kind {kind!r} missing required "
+                    f"field {field!r}")
+        if kind == "stage" and rec.get("status") not in STAGE_STATUSES:
+            problems.append(
+                f"{where}:{i}: stage status {rec.get('status')!r} not in "
+                f"{sorted(STAGE_STATUSES)}")
+    if expect_terminal and last_kind != "run_summary":
+        problems.append(
+            f"{where}: last event kind is {last_kind!r}, expected the "
+            "'run_summary' terminal event")
+    if records == 0:
+        problems.append(f"{where}: no records")
+    return problems
+
+
+def validate_file(path: str, *, expect_terminal: bool = False) -> list[str]:
+    with open(path) as fh:
+        return validate_lines(fh, where=path, expect_terminal=expect_terminal)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Validate a metrics JSONL stream against the known "
+                    "event schema")
+    parser.add_argument("paths", nargs="+")
+    parser.add_argument("--expect-terminal", action="store_true",
+                        help="require the stream to end with a run_summary "
+                             "event (streams written by the CLI do)")
+    args = parser.parse_args(argv)
+    all_problems: list[str] = []
+    for path in args.paths:
+        all_problems += validate_file(path,
+                                      expect_terminal=args.expect_terminal)
+    for p in all_problems:
+        print(p, file=sys.stderr)
+    if not all_problems:
+        print(f"OK: {len(args.paths)} stream(s) valid")
+    return 1 if all_problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
