@@ -115,6 +115,13 @@ class ScoreConfig:
     eval_mode: bool = True
     # Fused Pallas score kernels: None = auto (on for TPU backends, off elsewhere).
     use_pallas: bool | None = None
+    # Chunked score engine: K score batches compiled into ONE dispatch over
+    # the pre-batched pre-sharded resident blocks (ops/scores.make_score_chunk
+    # scanning ops/scoring.ScoreResident) — bit-identical to the per-batch
+    # path.
+    # None = auto (the whole epoch per dispatch on resident single-process
+    # passes, clamped to ops/scoring.MAX_SCORE_CHUNK_STEPS); 0/1 = per-batch.
+    chunk_steps: int | None = None
     # Reuse previously-computed scores from a saved npz (as written by the
     # run/score/sweep commands) instead of scoring: prune/retrain experiments
     # then pay zero scoring cost. The npz's indices are joined to the dataset
@@ -373,6 +380,10 @@ class Config:
             raise ValueError(
                 f"train.chunk_steps must be >= 0 (0/1 = per-step, null = "
                 f"auto), got {self.train.chunk_steps}")
+        if self.score.chunk_steps is not None and self.score.chunk_steps < 0:
+            raise ValueError(
+                f"score.chunk_steps must be >= 0 (0/1 = per-batch, null = "
+                f"auto), got {self.score.chunk_steps}")
         r = self.resilience
         if r.step_timeout_s is not None and r.step_timeout_s <= 0:
             raise ValueError(

@@ -98,6 +98,17 @@ STEM_XLA = _toggle("DDT_GRAND_STEM_XLA", True)
 # phase: cotangents are consumed where they are produced and never become
 # grad *outputs*, so the all-layer cotangent pytree is no longer live at once.
 FUSED_BWD = _toggle("DDT_GRAND_FUSED", False)
+# Layout-persistent megakernel (``pallas_kernels.conv_bwd_grad_norm_sq_pallas``
+# through the fused-tap machinery): for eligible unit-stride convs the layer
+# BACKWARD and the weight-grad-norm contraction run in ONE Pallas launch,
+# sharing the cotangent tile while it is VMEM-resident — the per-layer kernel
+# boundary (layout transition out of the bwd custom call and back into the
+# contraction call, the round-5-measured ~26 ms term the fused-custom_vjp
+# parity result proved is NOT graph structure) disappears, and stage-1's
+# 64-channel contractions are example-packed into full 128-lane tiles inside
+# the same kernel. Default off: promotion is by on-chip bisection
+# (tools/bisect_grand.py `megakernel` combos), never assumed.
+MEGAKERNEL = _toggle("DDT_GRAND_MEGAKERNEL", False)
 
 
 def _canon_tuple(v, n: int) -> tuple:
@@ -230,6 +241,21 @@ def _explicit_padding(padding, x: jax.Array, g: jax.Array, rec: dict):
 _DIRECT_OVER_GRAM_MAX_RATIO = 8.0
 
 
+def _conv_sfk(rec: dict, x_shape, g_shape) -> tuple[int, int, int]:
+    """(S output positions, F patch width, K output channels) for a conv —
+    the geometry every dispatch gate reasons in."""
+    return (np_prod(g_shape[1:-1]),
+            np_prod(rec["kernel_size"]) * x_shape[-1], g_shape[-1])
+
+
+def _direct_form_ok(s: int, f: int, k: int) -> bool:
+    """Direct-form kernels are eligible iff their FLOPs stay within the
+    measured ratio of the Gram form's — THE predicate, shared by the
+    two-phase dispatch (``_conv_contrib``) and the megakernel route
+    (``_mega_conv_route``) so the two cannot drift."""
+    return f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
+
+
 def _conv_bias_term(g: jax.Array, batch: int, s: int) -> jax.Array:
     """[B] squared norm of the per-example conv bias gradient ``Σ_s g``."""
     return _sq(jnp.sum(g.astype(_F32).reshape(batch, s, -1), axis=1), axis=-1)
@@ -239,13 +265,11 @@ def _conv_contrib(rec: dict, x: jax.Array, g: jax.Array,
                   use_pallas: bool = False) -> jax.Array:
     """[B] Frobenius-norm² of the per-example conv weight gradient ``P_iᵀ G_i``."""
     batch = x.shape[0]
-    s = int(np_prod(g.shape[1:-1]))
-    f = int(np_prod(rec["kernel_size"])) * x.shape[-1]
-    k = g.shape[-1]
+    s, f, k = _conv_sfk(rec, x.shape, g.shape)
     gram = s * (f + k) < f * k
     # Kernel-eligible iff direct FLOPs are within the ratio of Gram's (the
     # not-gram case satisfies this by definition: f*k <= s*(f+k)).
-    direct_ok = f * k <= _DIRECT_OVER_GRAM_MAX_RATIO * s * (f + k)
+    direct_ok = _direct_form_ok(s, f, k)
     if STEM_XLA and f < 32:
         # Tiny-F layers (the 3-channel stem) under-fill every MXU form; let
         # XLA's fused patch einsum take them (bisection toggle).
@@ -435,8 +459,25 @@ def _refuse_shared_modules(records: list[dict]) -> None:
             "use the grand_vmap score method")
 
 
+def _mega_conv_route(rec: dict, x: jax.Array, g: jax.Array) -> bool:
+    """Whether a conv layer takes the megakernel in the fused backward: the
+    shared direct-vs-Gram predicate (``_direct_form_ok`` — Gram-regime
+    layers would pay the direct form's extra FLOPs), a tiny-F stem gate
+    (UNCONDITIONAL here, unlike the two-phase path's STEM_XLA toggle which
+    only picks the stem's contraction route: a 25 %-filled megakernel dot
+    has no toggle worth bisecting), plus the kernel's own unit-stride/VMEM
+    eligibility."""
+    from .pallas_kernels import conv_bwd_norm_eligible
+    s, f, k = _conv_sfk(rec, x.shape, g.shape)
+    if f < 32 or not _direct_form_ok(s, f, k):
+        return False
+    return conv_bwd_norm_eligible(x.shape, g.shape, rec["kernel_size"],
+                                  rec["strides"], x.dtype.itemsize)
+
+
 def batched_grand_scores_fused(model, variables, image, label, mask,
-                               use_pallas: bool = False) -> jax.Array:
+                               use_pallas: bool = False,
+                               megakernel: bool = False) -> jax.Array:
     """Exact per-example GraNd with per-layer contractions fused INTO the
     backward pass. Same math as ``batched_grand_scores`` (verified to the same
     ``vmap(grad)`` tolerance) but instead of differentiating w.r.t. zero output
@@ -447,7 +488,15 @@ def batched_grand_scores_fused(model, variables, image, label, mask,
     accumulator input. ``jax.grad`` w.r.t. the accumulators then yields every
     per-layer contribution from ONE backward in which each contraction sits
     immediately after the op that produced its ``g`` — no second phase, no
-    all-layer cotangent tree materialized as grad outputs."""
+    all-layer cotangent tree materialized as grad outputs.
+
+    ``megakernel`` (requires ``use_pallas``): eligible unit-stride convs route
+    their taps through ``conv_bwd_grad_norm_sq_pallas`` — the tap's backward
+    RETURNS the layer's input cotangent from the same launch that computes the
+    contraction (the conv's own XLA backward receives a zero cotangent and
+    folds away), so the per-layer bwd→contraction kernel boundary vanishes.
+    Ineligible layers (stems, strided/projection convs, Gram-regime stage-4,
+    Dense, BatchNorm) keep the plain fused taps."""
     from .scores import cross_entropy  # local import: scores.py imports this module
 
     # The fused path contracts strictly per layer — the grouping/stacked-BN
@@ -456,9 +505,16 @@ def batched_grand_scores_fused(model, variables, image, label, mask,
     # program (same policy as _toggle's typo rejection).
     if GROUP_CONV or GROUP_BN or USE_BN_KERNEL:
         raise ValueError(
-            "DDT_GRAND_FUSED=1 is incompatible with DDT_GRAND_GROUP_CONV/"
-            "GROUP_BN/BN_KERNEL (the fused backward contracts per layer; "
-            "grouping exists only in the two-phase path)")
+            "DDT_GRAND_FUSED=1/DDT_GRAND_MEGAKERNEL=1 is incompatible with "
+            "DDT_GRAND_GROUP_CONV/GROUP_BN/BN_KERNEL (the fused backward "
+            "contracts per layer; grouping exists only in the two-phase path)")
+    if megakernel and not use_pallas:
+        # The megakernel IS a Pallas kernel: without the Pallas route there is
+        # no fused-launch program to measure, and silently falling back would
+        # mislabel a bisect combo.
+        raise ValueError(
+            "DDT_GRAND_MEGAKERNEL=1 requires the Pallas route "
+            "(score.use_pallas must not be disabled)")
 
     records: list[dict] = []
     cap_int = _make_interceptor(records)
@@ -500,7 +556,41 @@ def batched_grand_scores_fused(model, variables, image, label, mask,
         tap.defvjp(fwd, bwd)
         return tap
 
-    taps = {path: _make_tap(rec) for path, rec in rec_by_path.items()}
+    def _make_mega_tap(rec: dict):
+        """Conv tap whose backward COMPUTES the layer's input cotangent in the
+        same Pallas launch as the contraction (the megakernel). The conv's own
+        XLA backward receives a zero output-cotangent and folds away; ``dx``
+        is supplied through the x slot instead. Geometry routing happens at
+        trace time (shapes are concrete here): ineligible shapes take the
+        plain fused tap's math with the weight ignored."""
+        from .pallas_kernels import conv_bwd_grad_norm_sq_pallas
+
+        @jax.custom_vjp
+        def tap(y, x, wgt, acc):
+            return y
+
+        def fwd(y, x, wgt, acc):
+            return y, (x, wgt)
+
+        def bwd(res, g):
+            x, wgt = res
+            if _mega_conv_route(rec, x, g):
+                pad = _explicit_padding(rec["padding"], x, g, rec)
+                dx, contrib = conv_bwd_grad_norm_sq_pallas(
+                    x, g, wgt, tuple(rec["kernel_size"]), pad,
+                    use_bias=rec["use_bias"])
+                return jnp.zeros_like(g), dx, jnp.zeros_like(wgt), contrib
+            return (g, jnp.zeros_like(x), jnp.zeros_like(wgt),
+                    _contrib(rec, x, g))
+
+        tap.defvjp(fwd, bwd)
+        return tap
+
+    mega_paths = ({path for path, rec in rec_by_path.items()
+                   if rec["kind"] == "conv"} if megakernel else set())
+    taps = {path: (_make_mega_tap(rec) if path in mega_paths
+                   else _make_tap(rec))
+            for path, rec in rec_by_path.items()}
     # The interceptor runs inside model.apply, so the traced accumulators reach
     # it through this cell (rebound per loss_fn call).
     acc_cell: dict = {}
@@ -513,6 +603,9 @@ def batched_grand_scores_fused(model, variables, image, label, mask,
             return next_fun(*args, **kwargs)
         path = tuple(mod.path)
         y = next_fun(*args, **kwargs)
+        if path in mega_paths:
+            wgt = _leaf(variables["params"], path, "kernel")
+            return taps[path](y, args[0], wgt, acc_cell[path])
         return taps[path](y, args[0], acc_cell[path])
 
     def loss_fn(accs):

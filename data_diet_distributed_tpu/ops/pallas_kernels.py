@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the scoring hot path.
 
-Seven fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
+Eight fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventions):
 
 * ``el2n_pallas`` — fused ``softmax -> subtract one-hot -> row L2 norm -> mask``
   over logits. One VMEM round-trip instead of four HBM-materialized intermediates.
@@ -31,6 +31,18 @@ Seven fused kernels (see /opt/skills/guides/pallas_guide.md for the API conventi
   once with zero wasted FLOPs (see its docstring for the identity).
 * ``bn_grad_norm_sq_pallas`` — eval-mode BatchNorm per-example grad-norm² in
   one VMEM pass, with same-shape layers stackable into a single launch.
+* ``conv_bwd_grad_norm_sq_pallas`` — the layout-persistent MEGAKERNEL
+  (``DDT_GRAND_MEGAKERNEL``): the layer's input-cotangent backward AND the
+  weight-grad-norm contraction in ONE launch per layer, sharing the cotangent
+  tile while it is VMEM-resident. The round-5 profile attributed ~26 ms of a
+  74.9 ms batch-1024 pass to kernel-boundary composition (layout transitions
+  into/out of each per-layer custom call — proven NOT to be graph structure
+  by the fused-``custom_vjp`` parity result); fusing the two consumers of
+  ``g`` removes one full boundary per conv layer. Within the same kernel,
+  stage-1's 64-channel contractions are example-PACKED into full 128-lane
+  tiles (two examples lane-concatenated per dot: 2× the FLOPs at 4× the MXU
+  fill — rejected as a standalone kernel in round 3 when the pack cost showed
+  up as extra boundaries, revisited here where it is free).
 
 All kernels tile the batch dimension (fp32-aligned tiles) and keep channel
 dimensions whole (Mosaic pads the lane dimension internally). Padded batch rows
@@ -343,6 +355,190 @@ def conv_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, kernel_size, strides,
             x_phase = _grow(x_phase, khp - 1 + ho, kwp - 1 + wo)
             total = total + _unit_stride_norm_sq(x_phase, g, khp, kwp, interpret)
     return total
+
+
+# --------------------------------------------------------------------------
+# Layout-persistent megakernel: conv backward + weight-grad-norm, ONE launch.
+# --------------------------------------------------------------------------
+#
+# The round-5 ceiling analysis (PERFORMANCE.md) pinned ~26 ms of the 74.9 ms
+# batch-1024 pass on kernel-boundary composition: the cotangent g of every
+# conv layer is materialized by XLA's conv backward, leaves VMEM, and is
+# re-staged (with a layout transition) into the per-layer contraction kernel.
+# The fused-custom_vjp experiment proved the cost is NOT graph structure —
+# moving the contraction next to the backward op changed nothing — so the
+# remaining attack is to make the backward and the contraction the SAME
+# kernel: this megakernel computes, per layer, BOTH
+#
+#   dx[b] = conv_transpose(g[b], W)         (the layer's input cotangent)
+#   ‖∂W_b‖² = Σ_o ‖Σ_s x[b, s+o] g[b, s]‖²  (the per-example weight-grad norm)
+#
+# from one VMEM residency of the g tile. It is wired in through a custom_vjp
+# tap (grand_batched._make_mega_tap) that supplies dx as the conv INPUT's
+# cotangent and zeros the conv's own backward out of the graph.
+#
+# Stage-1 example packing, revisited: at C = K = 64 each per-offset [C, K]
+# dot fills 25 % of the 128×128 MXU — 43 % of contraction time ran at
+# 21.6 TF/s because of it. Lane-concatenating TWO examples' x and g tiles
+# ([S, 2C] × [S, 2K] → [2C, 2K]) computes both examples' M blocks on the
+# diagonal at 100 % lane fill; the off-diagonal cross-example blocks are
+# wasted FLOPs (2× work, 4× fill → net 2× ceiling). Round 3 built this as a
+# standalone kernel and measured it SLOWER — the pack/unpack copies were new
+# kernel boundaries; here the operands are already VMEM-resident, so the
+# pack is a register shuffle and the trade is re-measured, not assumed
+# (tools/bisect_grand.py `megakernel` combos).
+
+_MEGA_VMEM_CAP = 96 << 20
+
+
+def _mega_need_bytes(hp, wp, c, ho, wo, k, kh, kw, itemsize,
+                     tile: int = 8) -> int:
+    """Estimated per-grid-step VMEM bytes for the megakernel."""
+    lane = 128
+    cpad, kpad = -(-c // lane) * lane, -(-k // lane) * lane
+    blocks = 2 * tile * (hp * wp * cpad + ho * wo * kpad) * itemsize
+    gbig = tile * (hp + kh - 1) * (wp + kw - 1) * kpad * itemsize
+    dx_out = tile * hp * wp * cpad * 4
+    dx_acc = tile * hp * wp * cpad * 4
+    m = tile * cpad * kpad * 4
+    wgt = kh * kw * cpad * kpad * 4
+    return blocks + gbig + 2 * dx_out + dx_acc + m + wgt
+
+
+def conv_bwd_norm_eligible(x_shape, g_shape, kernel_size, strides,
+                           itemsize: int = 2) -> bool:
+    """Whether the megakernel can run this layer: unit stride (the strided
+    entry/projection layers are small and stay on the two-phase path) and a
+    working set inside the raised scoped-VMEM cap."""
+    if tuple(strides) != (1, 1):
+        return False
+    kh, kw = kernel_size
+    c = x_shape[-1]
+    ho, wo, k = g_shape[1:]
+    hp, wp = kh - 1 + ho, kw - 1 + wo
+    return _mega_need_bytes(hp, wp, c, ho, wo, k, kh, kw,
+                            itemsize) <= _MEGA_VMEM_CAP
+
+
+def _conv_bwd_norm_kernel(kh, kw, pack, use_bias,
+                          x_ref, g_ref, w_ref, dx_ref, out_ref, gbig):
+    """dx_pad AND ‖∂W‖² from one residency of the g tile.
+
+    ``gbig`` is g zero-embedded at spatial offset (kh-1, kw-1) so every
+    shifted window the transposed conv needs is a contiguous slice."""
+    xb = x_ref[...]                       # [TB, Hp, Wp, C]
+    gb = g_ref[...]                       # [TB, Ho, Wo, K]
+    wgt = w_ref[...]                      # [kh, kw, C, K]
+    tb, ho, wo, k = gb.shape
+    hp, wp, c = xb.shape[1], xb.shape[2], xb.shape[3]
+    s = ho * wo
+    g2 = gb.reshape(tb, s, k)
+
+    # ---- weight-grad-norm contraction (per offset, g tile shared) ----
+    if pack:
+        # C = K = 64: two examples per dot, diagonal blocks are the two Ms.
+        ge = jnp.concatenate([g2[0::2], g2[1::2]], axis=-1)   # [TB/2, S, 2K]
+        te = jnp.zeros((tb // 2, 1), jnp.float32)
+        to = jnp.zeros((tb // 2, 1), jnp.float32)
+        for oy in range(kh):
+            for ox in range(kw):
+                xs = xb[:, oy:oy + ho, ox:ox + wo, :].reshape(tb, s, c)
+                xe = jnp.concatenate([xs[0::2], xs[1::2]], axis=-1)
+                m = jax.lax.dot_general(   # [TB/2, 2C, 2K]
+                    xe, ge, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                msq = m * m
+                te = te + jnp.sum(jnp.sum(msq[:, :c, :k], axis=2), axis=1,
+                                  keepdims=True)
+                to = to + jnp.sum(jnp.sum(msq[:, c:, k:], axis=2), axis=1,
+                                  keepdims=True)
+        total = jnp.concatenate([te, to], axis=1).reshape(tb, 1)
+    else:
+        total = jnp.zeros((tb, 1), jnp.float32)
+        for oy in range(kh):
+            for ox in range(kw):
+                xs = xb[:, oy:oy + ho, ox:ox + wo, :]
+                m = jax.lax.dot_general(   # [TB, C, K]
+                    xs.reshape(tb, s, c), g2,
+                    dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
+                total = total + jnp.sum(jnp.sum(m * m, axis=2), axis=1,
+                                        keepdims=True)
+    if use_bias:
+        gsum = jnp.sum(g2.astype(jnp.float32), axis=1)
+        total = total + jnp.sum(gsum * gsum, axis=1, keepdims=True)
+    out_ref[...] = total
+
+    # ---- input cotangent: dx_pad[y, x] = Σ_o g[y-oy, x-ox] · W[oy, ox]ᵀ ----
+    gbig[...] = jnp.zeros_like(gbig)
+    gbig[:, kh - 1:kh - 1 + ho, kw - 1:kw - 1 + wo, :] = gb
+    acc = jnp.zeros((tb, hp * wp, c), jnp.float32)
+    for oy2 in range(kh):
+        for ox2 in range(kw):
+            gs = gbig[:, oy2:oy2 + hp, ox2:ox2 + wp, :]
+            acc = acc + jax.lax.dot_general(   # contract K: [TB, Hp·Wp, C]
+                gs.reshape(tb, hp * wp, k), wgt[kh - 1 - oy2, kw - 1 - ox2],
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dx_ref[...] = acc.reshape(tb, hp, wp, c)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel_size", "padding",
+                                             "use_bias", "interpret"))
+def conv_bwd_grad_norm_sq_pallas(x: jax.Array, g: jax.Array, wgt: jax.Array,
+                                 kernel_size, padding, use_bias: bool = False,
+                                 interpret: bool | None = None):
+    """(dx [B, H, W, C], norm_sq [B]) ⟵ the conv input cotangent and the
+    per-example weight-grad norm² (+ bias-grad² when ``use_bias``) in ONE
+    kernel launch — unit-stride convs, explicit ``padding`` pairs.
+
+    ``wgt`` is the conv kernel [kh, kw, C, K]; ``dx`` is returned in
+    ``x.dtype`` (f32-accumulated). The caller decides example packing is
+    never exposed: C = K = 64 layers pack automatically."""
+    kh, kw = kernel_size
+    b, h, w_in, c = x.shape
+    ho, wo, k = g.shape[1:]
+    x_pad = jnp.pad(x, ((0, 0), padding[0], padding[1], (0, 0)))
+    x_pad = _grow(x_pad, kh - 1 + ho, kw - 1 + wo)
+    hp, wp = x_pad.shape[1:3]
+    tile = 8
+    (x_pad, g), b_pad = _pad_batch([x_pad, g], b, tile)
+    pack = c == 64 and k == 64
+    need = _mega_need_bytes(hp, wp, c, ho, wo, k, kh, kw,
+                            x_pad.dtype.itemsize, tile)
+    params = (pltpu.CompilerParams(
+                  vmem_limit_bytes=min(5 * need // 2, _MEGA_VMEM_CAP))
+              if 5 * need // 2 > _SCOPED_VMEM_DEFAULT else None)
+    dx_pad, out = pl.pallas_call(
+        functools.partial(_conv_bwd_norm_kernel, kh, kw, pack, use_bias),
+        grid=(b_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, hp, wp, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, ho, wo, k), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kh, kw, c, k), lambda i: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, hp, wp, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b_pad, hp, wp, c), jnp.float32),
+            jax.ShapeDtypeStruct((b_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, hp + kh - 1, wp + kw - 1, k), g.dtype),
+        ],
+        compiler_params=params,
+        interpret=_auto_interpret(interpret),
+    )(x_pad, g, wgt)
+    pt, plft = padding[0][0], padding[1][0]
+    dx = dx_pad[:b, pt:pt + h, plft:plft + w_in, :].astype(x.dtype)
+    return dx, out[:b, 0]
 
 
 # --------------------------------------------------------------------------

@@ -138,30 +138,114 @@ def resolve_use_pallas(use_pallas: bool | None) -> bool:
     return jax.default_backend() == "tpu" if use_pallas is None else use_pallas
 
 
+def make_local_scores(model, method: str, *, chunk: int = 32,
+                      eval_mode: bool = True, use_pallas: bool = False):
+    """The per-device ``(variables, image, label, mask) -> scores [B]``
+    function for ``method`` — the ONE definition shared by the per-batch step
+    factories (``_wrap``-ed below) and the chunked score engine
+    (``make_score_chunk``), so the two engines execute the same score math
+    and cannot drift. ``use_pallas`` must already be resolved (bool)."""
+    if method == "el2n":
+        def local_scores(variables, image, label, mask):
+            logits = _forward(model, variables, image, eval_mode=eval_mode)
+            if use_pallas:
+                return el2n_pallas(logits, label, mask)
+            return el2n_from_logits(logits, label) * mask
+        return local_scores
+
+    if method == "margin":
+        def local_scores(variables, image, label, mask):
+            logits = _forward(model, variables, image, eval_mode=eval_mode)
+            return margin_from_logits(logits, label) * mask
+        return local_scores
+
+    if method == "correctness":
+        def local_scores(variables, image, label, mask):
+            logits = _forward(model, variables, image, eval_mode=eval_mode)
+            return (jnp.argmax(logits, -1) == label).astype(jnp.float32) * mask
+        return local_scores
+
+    if method == "grand_last_layer":
+        def local_scores(variables, image, label, mask):
+            logits, feats = _forward(model, variables, image,
+                                     eval_mode=eval_mode,
+                                     capture_features=True)
+            if use_pallas:
+                # The fused kernel redoes the classifier matmul in VMEM; the
+                # model's logits are unused here and DCE'd, so the classifier
+                # matmul still happens exactly once.
+                head = variables["params"]["classifier"]
+                return grand_last_layer_pallas(feats, head["kernel"],
+                                               head["bias"], label, mask)
+            return grand_last_layer_from_logits(logits, feats, label) * mask
+        return local_scores
+
+    if method == "grand_batched":
+        from . import grand_batched
+        # Module-attribute access (not by-name import): the composition
+        # toggles are resolved at factory-call time. Only env-pinned
+        # subprocesses can rely on them — the step factories are
+        # functools.cache'd, so in-process patching after a first call
+        # returns the previously-cached path (tests call the score functions
+        # directly for exactly that reason; tests/test_grand_batched.py).
+        if grand_batched.MEGAKERNEL:
+            score_fn = partial(grand_batched.batched_grand_scores_fused,
+                               megakernel=True)
+        elif grand_batched.FUSED_BWD:
+            score_fn = grand_batched.batched_grand_scores_fused
+        else:
+            score_fn = grand_batched.batched_grand_scores
+
+        def local_scores(variables, image, label, mask):
+            return score_fn(model, variables, image, label, mask,
+                            use_pallas=use_pallas)
+        return local_scores
+
+    if method == "grand_vmap":
+        def per_example_norm(variables, image, label):
+            rest = {k: v for k, v in variables.items() if k != "params"}
+
+            def loss_fn(params):
+                logits = _forward(model, {"params": params, **rest},
+                                  image[None], eval_mode=eval_mode)
+                return cross_entropy(logits, label[None])[0]
+
+            grads = jax.grad(loss_fn)(variables["params"])
+            return optax.global_norm(grads)
+
+        def local_scores(variables, image, label, mask):
+            n = image.shape[0]
+            c = min(chunk, n)
+            if n % c != 0:  # static shapes: pad slice up to a chunk multiple
+                pad = c - n % c
+                image = jnp.concatenate(
+                    [image, jnp.zeros((pad, *image.shape[1:]), image.dtype)])
+                label = jnp.concatenate(
+                    [label, jnp.zeros((pad,), label.dtype)])
+            imgs = image.reshape(-1, c, *image.shape[1:])
+            labs = label.reshape(-1, c)
+            norms = jax.lax.map(
+                lambda xs: jax.vmap(partial(per_example_norm, variables))(*xs),
+                (imgs, labs))
+            return norms.reshape(-1)[:n] * mask
+        return local_scores
+
+    raise ValueError(f"unknown score method {method!r}")
+
+
 @functools.cache
 def make_el2n_step(model, mesh: Mesh | None = None, eval_mode: bool = True,
                    use_pallas: bool | None = None):
     """Forward-only EL2N over a (possibly mesh-sharded) batch."""
-    use_pallas = resolve_use_pallas(use_pallas)
-
-    def local_scores(variables, image, label, mask):
-        logits = _forward(model, variables, image, eval_mode=eval_mode)
-        if use_pallas:
-            return el2n_pallas(logits, label, mask)
-        return el2n_from_logits(logits, label) * mask
-
-    return _wrap(local_scores, mesh)
+    return _wrap(make_local_scores(
+        model, "el2n", eval_mode=eval_mode,
+        use_pallas=resolve_use_pallas(use_pallas)), mesh)
 
 
 @functools.cache
 def make_margin_step(model, mesh: Mesh | None = None, eval_mode: bool = True):
     """Forward-only margin difficulty over a (possibly mesh-sharded) batch."""
-
-    def local_scores(variables, image, label, mask):
-        logits = _forward(model, variables, image, eval_mode=eval_mode)
-        return margin_from_logits(logits, label) * mask
-
-    return _wrap(local_scores, mesh)
+    return _wrap(make_local_scores(model, "margin", eval_mode=eval_mode), mesh)
 
 
 @functools.cache
@@ -170,73 +254,34 @@ def make_correctness_step(model, mesh: Mesh | None = None,
     """Per-example 0/1 correctness [B] over a (possibly mesh-sharded) batch —
     the per-epoch signal the forgetting-events score accumulates
     (``ops/forgetting.ForgettingTracker``). Padded rows report 0."""
-
-    def local_scores(variables, image, label, mask):
-        logits = _forward(model, variables, image, eval_mode=eval_mode)
-        return (jnp.argmax(logits, -1) == label).astype(jnp.float32) * mask
-
-    return _wrap(local_scores, mesh)
+    return _wrap(make_local_scores(model, "correctness", eval_mode=eval_mode),
+                 mesh)
 
 
 @functools.cache
 def make_grand_last_layer_step(model, mesh: Mesh | None = None,
                                eval_mode: bool = True,
                                use_pallas: bool | None = None):
-    use_pallas = resolve_use_pallas(use_pallas)
-
-    def local_scores(variables, image, label, mask):
-        logits, feats = _forward(model, variables, image,
-                                 eval_mode=eval_mode, capture_features=True)
-        if use_pallas:
-            # The fused kernel redoes the classifier matmul in VMEM; the model's
-            # logits are unused here and DCE'd, so the matmul still runs once.
-            head = variables["params"]["classifier"]
-            return grand_last_layer_pallas(feats, head["kernel"], head["bias"],
-                                           label, mask)
-        return grand_last_layer_from_logits(logits, feats, label) * mask
-
-    return _wrap(local_scores, mesh)
+    return _wrap(make_local_scores(
+        model, "grand_last_layer", eval_mode=eval_mode,
+        use_pallas=resolve_use_pallas(use_pallas)), mesh)
 
 
 @functools.cache
 def make_grand_step(model, mesh: Mesh | None = None, chunk: int = 32,
                     eval_mode: bool = True,
                     use_pallas: bool | None = None):
-    """Full GraNd: per-example gradient norm over ALL parameters.
+    """Full GraNd: per-example gradient norm over ALL parameters, the naive
+    ``vmap(grad)`` way.
 
     Inside ``shard_map`` each device sees its local slice of the batch; the slice is
     reshaped to ``[n_chunks, chunk]`` and ``lax.map`` runs a ``vmap`` of single-example
     grads per chunk, reducing each gradient to its global norm immediately so at most
     ``chunk`` gradient pytrees are live per device.
     """
-
-    def per_example_norm(variables, image, label):
-        rest = {k: v for k, v in variables.items() if k != "params"}
-
-        def loss_fn(params):
-            logits = _forward(model, {"params": params, **rest}, image[None],
-                              eval_mode=eval_mode)
-            return cross_entropy(logits, label[None])[0]
-
-        grads = jax.grad(loss_fn)(variables["params"])
-        return optax.global_norm(grads)
-
-    def local_scores(variables, image, label, mask):
-        n = image.shape[0]
-        c = min(chunk, n)
-        if n % c != 0:  # static shapes: pad local slice up to a chunk multiple
-            pad = c - n % c
-            image = jnp.concatenate([image, jnp.zeros((pad, *image.shape[1:]),
-                                                      image.dtype)])
-            label = jnp.concatenate([label, jnp.zeros((pad,), label.dtype)])
-        imgs = image.reshape(-1, c, *image.shape[1:])
-        labs = label.reshape(-1, c)
-        norms = jax.lax.map(
-            lambda xs: jax.vmap(partial(per_example_norm, variables))(*xs),
-            (imgs, labs))
-        return norms.reshape(-1)[:n] * mask
-
-    return _wrap(local_scores, mesh)
+    return _wrap(make_local_scores(
+        model, "grand_vmap", chunk=chunk, eval_mode=eval_mode,
+        use_pallas=resolve_use_pallas(use_pallas)), mesh)
 
 
 @functools.cache
@@ -250,23 +295,13 @@ def make_grand_batched_step(model, mesh: Mesh | None = None,
     ``use_pallas`` selects the fused conv-grad-norm kernel for the large-S
     conv layers (None = auto: on for TPU backends). ``DDT_GRAND_FUSED=1``
     routes through ``batched_grand_scores_fused`` (contractions inside the
-    backward pass) instead of the two-phase composition."""
-    from . import grand_batched
-    use_pallas = resolve_use_pallas(use_pallas)
-    # Module-attribute access (not by-name import): the toggle is resolved at
-    # factory-call time. Only env-pinned subprocesses can rely on it — this
-    # factory is functools.cache'd, so in-process patching of FUSED_BWD after
-    # a first call returns the previously-cached path (tests call the score
-    # functions directly for exactly that reason; see tests/test_grand_batched.py).
-    score_fn = (grand_batched.batched_grand_scores_fused
-                if grand_batched.FUSED_BWD
-                else grand_batched.batched_grand_scores)
-
-    def local_scores(variables, image, label, mask):
-        return score_fn(model, variables, image, label, mask,
-                        use_pallas=use_pallas)
-
-    return _wrap(local_scores, mesh)
+    backward pass) instead of the two-phase composition;
+    ``DDT_GRAND_MEGAKERNEL=1`` additionally routes eligible convs through the
+    layout-persistent backward+contraction megakernel
+    (``pallas_kernels.conv_bwd_grad_norm_sq_pallas``)."""
+    return _wrap(make_local_scores(
+        model, "grand_batched",
+        use_pallas=resolve_use_pallas(use_pallas)), mesh)
 
 
 @functools.cache
@@ -293,3 +328,73 @@ def make_score_step(model, method: str, mesh: Mesh | None = None, chunk: int = 3
         return make_grand_last_layer_step(model, mesh, eval_mode=eval_mode,
                                           use_pallas=use_pallas)
     raise ValueError(f"unknown score method {method!r}")
+
+
+def resolve_score_method(method: str, eval_mode: bool) -> str:
+    """The ``make_score_step`` dispatch rule as data: which local-scores
+    method a config-string method actually runs (``grand`` is the batched
+    exact algorithm in eval mode, ``vmap(grad)`` otherwise)."""
+    if method == "grand":
+        return "grand_batched" if eval_mode else "grand_vmap"
+    return method
+
+
+@functools.cache
+def make_score_chunk(model, method: str, mesh: Mesh | None = None,
+                     chunk: int = 32, eval_mode: bool = True,
+                     use_pallas: bool | None = None):
+    """K score batches compiled into ONE dispatch — the scoring twin of
+    ``train/steps.make_train_chunk``.
+
+    ``score_chunk(variables, images, labels, mask) -> scores [K, B]``: the
+    operands are ``[K, B, ...]`` blocks of the PRE-BATCHED resident dataset
+    (``ops/scoring.ScoreResident`` — batch composition identical to the host
+    assembler's: dataset order, row-0 tail images, zeroed tail labels,
+    mask 0), already laid out batch-dim-sharded over the flat mesh, and the
+    scan consumes them as ``xs`` — each step reads its batch slice straight
+    from the resident block, so the chunk needs no gather, no accumulator
+    and no layout change anywhere: one dispatch runs K score batches and the
+    stacked ``[K, B]`` output IS the score block, fetched once per seed.
+    Scores are BIT-identical to the per-batch engine's
+    (``tests/test_score_chunked.py`` pins it across the method registry).
+
+    The scan is fully unrolled and a length-1 tail bypasses it, for the same
+    compile-identity reasons as the train chunk (train/steps.py docstring).
+    ``use_pallas`` None resolves like the step factories."""
+    from ..obs import registry as obs_registry
+
+    local = make_local_scores(model, resolve_score_method(method, eval_mode),
+                              chunk=chunk, eval_mode=eval_mode,
+                              use_pallas=resolve_use_pallas(use_pallas))
+    if mesh is None or mesh.size == 1:
+        scores_fn = local
+    else:
+        from ..parallel.mesh import flat_batch_spec
+        spec = flat_batch_spec(mesh)
+        scores_fn = _shard_map(local, mesh=mesh,
+                               in_specs=(P(), spec, spec, spec),
+                               out_specs=spec)
+
+    def score_chunk(variables, images, labels, mask):
+        def body(_, xs):
+            img, lab, m = xs
+            return 0, scores_fn(variables, img, lab, m)
+
+        if images.shape[0] == 1:   # length-1 scan ≠ bare body bitwise
+            _, s = body(0, (images[0], labels[0], mask[0]))
+            return s[None]
+        _, s = jax.lax.scan(body, 0, (images, labels, mask), unroll=True)
+        return s
+
+    # No donation: every operand (variables, resident blocks) is reused by
+    # the next dispatch/seed; the chunk's output is freshly allocated.
+    jitted = jax.jit(score_chunk)
+
+    @functools.wraps(jitted)
+    def dispatch(*args, **kwargs):
+        # Host-side dispatch counter (train/steps._counted's pattern): the
+        # chunked engine's whole point is fewer dispatches — count them.
+        obs_registry.inc("dispatches_score_chunk")
+        return jitted(*args, **kwargs)
+
+    return dispatch

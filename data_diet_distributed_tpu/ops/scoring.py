@@ -16,8 +16,33 @@ import jax
 import numpy as np
 
 from ..data.datasets import ArrayDataset, make_position_joiner
-from ..data.pipeline import BatchSharder, device_stream, iterate_batches
-from .scores import make_score_step
+from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
+                             num_batches)
+from .scores import make_score_chunk, make_score_step
+
+#: Hard clamp on the score-chunk length (batches per dispatch): the chunk is
+#: fully unrolled (compile size grows with K), and one chunk is the dispatch
+#: granularity a host signal can interleave at. 32 b2048 GraNd batches per
+#: dispatch covers the 50k north-star epoch in one dispatch per seed.
+MAX_SCORE_CHUNK_STEPS = 32
+
+
+def resolve_score_chunk_steps(chunk_steps: int | None, n_batches: int,
+                              resident: bool) -> int:
+    """The chunked-score-engine selection policy (1 = the per-batch path).
+
+    ``None`` = auto: chunking on for single-process device-resident passes,
+    sized to the WHOLE epoch (one dispatch per seed) up to the clamp; 0/1 =
+    forced per-batch; K>1 = requested. Streaming passes and multi-host
+    runtimes fall back to per-batch — the chunk scans the RESIDENT gather,
+    and the scatter into the replicated accumulator assumes every device is
+    fed by this process."""
+    if chunk_steps is not None and chunk_steps <= 1:
+        return 1
+    if not resident or jax.process_count() > 1:
+        return 1
+    k = n_batches if chunk_steps is None else int(chunk_steps)
+    return max(1, min(k, n_batches, MAX_SCORE_CHUNK_STEPS))
 
 
 def _to_host(batched: list[jax.Array]) -> list[np.ndarray]:
@@ -39,11 +64,23 @@ _DEVICE_RESIDENT_PER_DEVICE_BYTES = 1 << 30
 _DEVICE_RESIDENT_MAX_BYTES = 4 << 30
 
 
+def fits_residency(ds: ArrayDataset, n_devices: int) -> bool:
+    """Whether the dataset's UPLOADED footprint (batches materialize as
+    float32 even when the dataset is lazy uint8/mmap on disk) fits the
+    device-residency budget — THE predicate ``score_dataset``'s auto rule
+    uses, public so ``bench.py`` predicts the same engine selection it
+    reports."""
+    budget = min(n_devices * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
+                 _DEVICE_RESIDENT_MAX_BYTES)
+    return ds.images.size * 4 <= budget
+
+
 def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   method: str = "el2n", batch_size: int = 512,
                   sharder: BatchSharder | None = None, chunk: int = 32,
                   eval_mode: bool = True, use_pallas: bool | None = None,
                   score_step=None, device_resident: bool | None = None,
+                  chunk_steps: int | None = None,
                   on_seed_done=None) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 
@@ -52,6 +89,17 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     (None = auto by dataset size) uploads the batches once and reuses them for
     every seed — multi-seed scoring then pays host→device transfer once, not
     ``n_seeds`` times.
+
+    ``chunk_steps`` arms the CHUNKED score engine on the resident path
+    (None = auto: the whole epoch per dispatch, clamped; 0/1 = per-batch):
+    the dataset uploads once as pre-batched pre-sharded blocks
+    (``ScoreResident``) and K score batches compile into one dispatch whose
+    scan reads each batch straight from the block
+    (``ops/scores.make_score_chunk``) — a full score epoch becomes ONE
+    dispatch per seed instead of N/B relay round-trips, with bit-identical
+    scores (``resolve_score_chunk_steps`` documents the streaming/multi-host
+    fallbacks; a caller-supplied ``score_step`` also forces per-batch, since
+    the chunk compiles its own program).
 
     ``on_seed_done(k, seed_scores)`` fires after each seed's full pass with
     that seed's float64 score vector (every process holds it, multi-host
@@ -73,6 +121,7 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         # would all-gather the classifier on EVERY batch invocation.
         from ..parallel.mesh import replicate
         variables_seeds = [replicate(v, mesh) for v in variables_seeds]
+    caller_step = score_step is not None
     if score_step is None:
         score_step = make_score_step(model, method, mesh, chunk=chunk,
                                      eval_mode=eval_mode, use_pallas=use_pallas)
@@ -89,12 +138,18 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         # Batches shard over every flattened mesh axis, so the per-device
         # budget scales with the full device count.
         n_dev = sharder.mesh.size if sharder is not None else 1
-        budget = min(n_dev * _DEVICE_RESIDENT_PER_DEVICE_BYTES,
-                     _DEVICE_RESIDENT_MAX_BYTES)
-        # Size the decision by the UPLOADED footprint (batches materialize as
-        # float32 even when the dataset is lazy uint8/mmap on disk).
-        device_resident = (len(variables_seeds) > 1
-                           and ds.images.size * 4 <= budget)
+        device_resident = ((len(variables_seeds) > 1 or chunk_steps)
+                           and fits_residency(ds, n_dev))
+
+    if not caller_step:
+        k_chunk = resolve_score_chunk_steps(
+            chunk_steps, num_batches(n, batch_size), bool(device_resident))
+        if k_chunk > 1:
+            return _score_dataset_chunked(
+                model, variables_seeds, ds, method=method,
+                batch_size=batch_size, sharder=sharder, chunk=chunk,
+                eval_mode=eval_mode, use_pallas=use_pallas, k_chunk=k_chunk,
+                on_seed_done=on_seed_done)
 
     def device_batches():
         if sharder is not None:
@@ -132,6 +187,97 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
             if len(pending) >= window:
                 flush()
         flush()
+        total += seed_scores
+        if on_seed_done is not None:
+            on_seed_done(k, seed_scores)
+    return (total / len(variables_seeds)).astype(np.float32)
+
+
+def _dispatch_score_chunk(chunk_fn, variables, images, labels, mask):
+    """One chunked score dispatch: K batches, one host round trip to enqueue.
+    A module-level seam (the ``train/loop._dispatch_chunk`` pattern) so tests
+    can count and interpose at chunk boundaries."""
+    return chunk_fn(variables, images, labels, mask)
+
+
+class ScoreResident:
+    """Pre-batched resident dataset for the chunked score engine.
+
+    ``images``/``labels``/``mask`` are ``[nb, B, ...]`` device arrays whose
+    batch composition matches the host assembler's EXACTLY (dataset order;
+    the tail batch padded with row-0 images, zeroed labels, mask 0 — row-0
+    image padding matters for the train-mode-BN reference quirk, where tail
+    content feeds the real rows' batch statistics), laid out with the batch
+    dim sharded over the flat mesh — the same layout the score step's
+    shard_map consumes, so the chunk's scan reads each batch straight out of
+    the block with no gather and no resharding anywhere."""
+
+    def __init__(self, ds: ArrayDataset, batch_size: int, mesh=None):
+        dense = ds.dense()   # lazy (mmap) datasets materialize normalized rows
+        self.n = len(ds)
+        self.nb = num_batches(self.n, batch_size)
+        self.batch_size = batch_size
+        pad = self.nb * batch_size - self.n
+        imgs = np.asarray(dense.images, np.float32)
+        if pad:
+            imgs = np.concatenate(
+                [imgs, np.broadcast_to(imgs[0], (pad, *imgs.shape[1:]))])
+        labels = np.zeros(self.nb * batch_size, np.int32)
+        labels[:self.n] = dense.labels
+        mask = np.zeros(self.nb * batch_size, np.float32)
+        mask[:self.n] = 1.0
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(mesh, P(None, tuple(mesh.axis_names)))
+
+            def put(a):
+                return jax.device_put(a, sharding)
+        else:
+            put = jax.device_put
+        self.images = put(np.ascontiguousarray(
+            imgs.reshape(self.nb, batch_size, *imgs.shape[1:])))
+        self.labels = put(labels.reshape(self.nb, batch_size))
+        self.mask = put(mask.reshape(self.nb, batch_size))
+
+    def blocks(self, k_chunk: int):
+        """``(images, labels, mask)`` operand triples of ``<= k_chunk``
+        batches each. The whole-epoch block (the auto default) is the
+        resident arrays THEMSELVES — no copy; clamped multi-chunk passes
+        slice (one contiguous device copy per block)."""
+        for s in range(0, self.nb, k_chunk):
+            e = min(s + k_chunk, self.nb)
+            if s == 0 and e == self.nb:
+                yield self.images, self.labels, self.mask
+            else:
+                yield self.images[s:e], self.labels[s:e], self.mask[s:e]
+
+
+def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
+                           *, method: str, batch_size: int,
+                           sharder: BatchSharder | None, chunk: int,
+                           eval_mode: bool, use_pallas: bool | None,
+                           k_chunk: int, on_seed_done=None) -> np.ndarray:
+    """The dispatch-free score epoch: the dataset uploaded ONCE as pre-batched
+    pre-sharded blocks (``ScoreResident``), then each seed's whole pass is
+    ``ceil(nb / K)`` chunked dispatches — one, on the default auto sizing —
+    and ONE fetch of the stacked score blocks. Single-process only
+    (``resolve_score_chunk_steps`` gates)."""
+    mesh = sharder.mesh if sharder is not None else None
+    multi = mesh is not None and mesh.size > 1
+    resident = ScoreResident(ds, batch_size, mesh)
+    chunk_fn = make_score_chunk(model, method, mesh if multi else None,
+                                chunk=chunk, eval_mode=eval_mode,
+                                use_pallas=use_pallas)
+    total = np.zeros(resident.n, np.float64)
+    for k, variables in enumerate(variables_seeds):
+        outs = [_dispatch_score_chunk(chunk_fn, variables, *blk)
+                for blk in resident.blocks(k_chunk)]
+        # ONE fetch per seed — the score blocks' round trip is the epoch's
+        # entire device→host traffic (float64 exactly represents every
+        # float32, so the resumed-partial mean stays bit-identical).
+        seed_scores = np.concatenate(
+            [np.asarray(o, np.float64) for o in jax.device_get(outs)],
+            axis=0).reshape(-1)[:resident.n]
         total += seed_scores
         if on_seed_done is not None:
             on_seed_done(k, seed_scores)

@@ -241,20 +241,73 @@ PROBE_SNIPPET = (
     "print(json.dumps({'n': len(ds), 'platform': ds[0].platform}))"
 )
 
+#: Test/ops hook: override the probe child's code (e.g. a deliberate sleep to
+#: prove the bounded-deadline path end-to-end, or an environment-specific
+#: claim sequence). The production snippet above is the default.
+PROBE_SNIPPET_ENV = "DDT_PROBE_SNIPPET"
+
+#: Operator-supplied claim-reset command (shell), run between failed probe
+#: attempts: the documented relay wedge is a claim left half-open by a
+#: SIGKILLed client, and some transports expose an explicit release/reset.
+#: Without one, the reset is a short clean claim+release cycle (below).
+CLAIM_RESET_CMD_ENV = "DDT_CLAIM_RESET_CMD"
+
+
+def reset_claim(timeout_s: float = 30.0) -> bool:
+    """Best-effort device-claim reset between probe attempts.
+
+    With ``DDT_CLAIM_RESET_CMD`` set, runs the operator's transport-specific
+    reset (bounded). Otherwise spawns one more short-deadline probe child
+    whose distinguishing property is a CLEAN exit: the wedge-maker is a
+    client killed mid-claim, and a complete claim→release cycle is the
+    generic way to return the claim state machine to idle. Returns whether
+    the reset action itself completed in budget — the next probe attempt is
+    the real verdict."""
+    cmd = os.environ.get(CLAIM_RESET_CMD_ENV)
+    try:
+        if cmd:
+            return subprocess.run(cmd, shell=True, capture_output=True,
+                                  timeout=timeout_s).returncode == 0
+        snippet = os.environ.get(PROBE_SNIPPET_ENV, PROBE_SNIPPET)
+        return subprocess.run([sys.executable, "-c", snippet],
+                              capture_output=True,
+                              timeout=timeout_s).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
 
 def probe_devices(attempts: int = 3, timeout_s: float = 150.0,
-                  backoff_s: float = 20.0, on_retry=None) -> dict:
+                  backoff_s: float = 20.0, on_retry=None,
+                  claim_reset: bool = True) -> dict:
     """Check that ``jax.devices()`` completes in a bounded subprocess.
 
     Returns the probe info dict (``{"n", "platform"}``) on success, or a
     failure-description dict with an ``"error"`` key after ``attempts`` tries.
+    Either way the dict carries capture-health diagnostics — ``attempts``
+    (probes actually run), ``wall_s``, ``resets`` (claim-reset actions
+    taken) — so a BENCH artifact is self-describing about how hard the
+    capture had to work. Total budget is bounded by
+    ``attempts × timeout_s + backoffs + resets × timeout_s/5`` — never a hang.
+
     Retries back off exponentially (``backoff_s``, ``2*backoff_s``, ...) —
     transient claim contention (a previous holder still exiting) resolves in
     seconds; the hard wedge does not resolve at all, which is exactly what the
     bounded timeout converts into a parseable failure instead of a hang.
+    After a TIMED-OUT attempt (the wedge signature, not an ordinary failure)
+    a claim reset (``reset_claim``) runs before the next try.
     ``on_retry(attempt, error)`` is called before each back-off sleep.
     """
+    t0 = time.monotonic()
+    snippet = os.environ.get(PROBE_SNIPPET_ENV, PROBE_SNIPPET)
     last_err = "unknown"
+    resets = 0
+    attempt = 0
+
+    def _info(base: dict) -> dict:
+        base.update(attempts=attempt + 1, resets=resets,
+                    wall_s=round(time.monotonic() - t0, 3))
+        return base
+
     for attempt in range(attempts):
         if attempt:
             if on_retry is not None:
@@ -262,18 +315,25 @@ def probe_devices(attempts: int = 3, timeout_s: float = 150.0,
             time.sleep(backoff_s * (2 ** (attempt - 1)))
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", PROBE_SNIPPET],
+                [sys.executable, "-c", snippet],
                 capture_output=True, text=True, timeout=timeout_s)
         except subprocess.TimeoutExpired:
             last_err = (f"backend probe hung >{timeout_s:.0f}s "
                         "(device-claim wedge)")
+            if claim_reset and attempt + 1 < attempts:
+                # The probe child was just SIGKILLed mid-claim — exactly the
+                # wedge-maker. Reset before retrying rather than re-probing
+                # into the claim state the kill may have poisoned.
+                resets += 1
+                reset_claim(max(1.0, timeout_s / 5.0))
             continue
         if proc.returncode == 0:
             try:
-                return json.loads(proc.stdout.strip().splitlines()[-1])
+                return _info(json.loads(proc.stdout.strip().splitlines()[-1]))
             except (ValueError, IndexError):
                 last_err = f"probe emitted unparseable output: {proc.stdout[-200:]}"
                 continue
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
         last_err = tail[-1][:300] if tail else f"probe rc={proc.returncode}"
-    return {"error": f"backend init failed after {attempts} attempts: {last_err}"}
+    return _info(
+        {"error": f"backend init failed after {attempts} attempts: {last_err}"})

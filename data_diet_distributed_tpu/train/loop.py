@@ -1087,6 +1087,7 @@ def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                           sharder=sharder, chunk=cfg.score.grand_chunk,
                           eval_mode=cfg.score.eval_mode,
                           use_pallas=cfg.score.use_pallas,
+                          chunk_steps=cfg.score.chunk_steps,
                           on_seed_done=on_seed_done)
             score_s = time.perf_counter() - t1
         passes = len(seeds_vars)

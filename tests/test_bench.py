@@ -7,6 +7,7 @@ import importlib.util
 import json
 import os
 import subprocess
+import time
 import sys
 from pathlib import Path
 
@@ -118,7 +119,86 @@ def test_probe_backend_success(monkeypatch):
     monkeypatch.setattr(wd_mod.subprocess, "run",
                         lambda cmd, **kw: FakeProc())
     info = bench.probe_backend(attempts=1, timeout_s=1.0)
-    assert info == {"n": 1, "platform": "tpu"}
+    assert info["n"] == 1 and info["platform"] == "tpu"
+    assert info["attempts"] == 1 and info["resets"] == 0
+
+
+def test_bench_bounded_json_under_injected_probe_hang():
+    """The r04/r05 wedge, simulated end-to-end: with the probe child hung
+    (DDT_PROBE_SNIPPET sleeps past the deadline), bench.py must terminate
+    within the bounded budget with a SINGLE parseable JSON line carrying
+    nonzero probe_attempts, the claim_reset count and an "error" field —
+    no 0.0-style silent wedge. --fresh-retries 1 covers the relay path: the
+    parent emits the fresh child's line, not two lines."""
+    env = dict(os.environ, JAX_PLATFORMS="bogus", PALLAS_AXON_POOL_IPS="",
+               DDT_PROBE_SNIPPET="import time; time.sleep(60)")
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--size", "64",
+         "--batch", "32", "--arch", "tiny_cnn",
+         "--probe-attempts", "2", "--probe-timeout", "2",
+         "--probe-backoff", "0.1", "--fresh-retries", "1"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    wall = time.monotonic() - t0
+    assert wall < 60, "bounded budget blown"
+    assert proc.returncode == 0, proc.stderr[-500:]
+    json_lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(json_lines) == 1           # exactly ONE parseable line
+    line = json.loads(json_lines[0])
+    assert "error" in line and "wedge" in line["error"]
+    assert line["probe_attempts"] == 2
+    assert line["claim_reset"] >= 1
+    assert line["probe_wall_s"] > 0
+    assert line["exit_class"] == "retriable" and line["exit_code"] == 69
+
+
+def test_fresh_process_retry_relays_child_json(monkeypatch, capsys):
+    """Probe failure + --fresh-retries: the child's JSON line is relayed
+    verbatim and its exit code propagated — the fresh process is how a
+    poisoned-claim parent can still capture the real number."""
+    bench = _load_bench()
+
+    class FakeChild:
+        returncode = 0
+        stdout = ('some gloo log line\n'
+                  '{"metric": "m", "value": 123.0, "unit": "u", '
+                  '"vs_baseline": 1.0}\n')
+        stderr = ""
+
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["cmd"] = cmd
+        return FakeChild()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        bench, "probe_backend",
+        lambda *a, **k: {"error": "backend init failed", "attempts": 3,
+                         "resets": 2, "wall_s": 1.0})
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench.py", "--size", "64", "--arch", "tiny_cnn",
+         "--fresh-retries", "2"])
+    with pytest.raises(SystemExit) as exc_info:
+        bench.main()
+    assert exc_info.value.code == 0
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.startswith("{")]
+    assert len(out_lines) == 1
+    assert json.loads(out_lines[0])["value"] == 123.0
+    # The child got a decremented budget — the recursion is bounded.
+    assert "--fresh-retries" in seen["cmd"]
+    assert seen["cmd"][seen["cmd"].index("--fresh-retries") + 1] == "1"
+
+
+def test_strip_fresh_retries():
+    bench = _load_bench()
+    assert bench._strip_fresh_retries(
+        ["bench.py", "--fresh-retries", "2", "--size", "64"]) == \
+        ["bench.py", "--size", "64"]
+    assert bench._strip_fresh_retries(
+        ["bench.py", "--fresh-retries=3"]) == ["bench.py"]
 
 
 def test_bench_northstar_smoke():

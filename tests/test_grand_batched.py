@@ -153,6 +153,77 @@ def test_fused_bwd_matches_vmap_wideresnet():
                                rtol=2e-4, atol=1e-5)
 
 
+class _Pack64CNN(nn.Module):
+    """Covers the megakernel dispatch tiers in the FULL algorithm: a 64×64
+    unit-stride conv (the example-PACKED megakernel path), a 3-channel stem
+    and a strided conv (plain-tap fallbacks) — geometry the zoo's fast lane
+    never reaches (resnet18's 64-channel stage is a slow-marked test)."""
+
+    @nn.compact
+    def __call__(self, x, *, train=False, capture_features=False):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=True)(x)   # packed mega
+        x = nn.relu(x)
+        x = nn.Conv(128, (3, 3), strides=(2, 2), padding=1)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(10, name="classifier")(x)
+        if capture_features:
+            return logits, x
+        return logits
+
+
+# Megakernel exactness across the model zoo (interpret mode on CPU — the
+# acceptance gate for DDT_GRAND_MEGAKERNEL; on-chip promotion is by measured
+# bisection only). Deep archs carry the slow marker like the other zoo
+# exactness re-checks; _Pack64CNN and _WideChannelCNN keep the packed 64×64
+# and 128/256-channel megakernel tiers in the fast lane.
+@pytest.mark.parametrize("make_model,hw", [
+    (lambda: create_model("tiny_cnn", 10), 16),
+    (lambda: _Pack64CNN(), 16),
+    (lambda: _WideChannelCNN(), 16),
+    (lambda: WideResNet(depth=10, widen_factor=1, num_classes=10), 16),
+    pytest.param(lambda: create_model("resnet18", 10), 16,
+                 marks=pytest.mark.slow),
+    pytest.param(lambda: create_model("resnet50", 10), 8,
+                 marks=pytest.mark.slow),
+])
+def test_megakernel_matches_vmap(make_model, hw):
+    """The megakernel pass (backward + contraction in one launch per eligible
+    conv, dx supplied through the tap) computes the identical GraNd scores."""
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+    model = make_model()
+    batch = _batch(8, hw, seed=9)
+    variables = _trained_stats(model, _init(model, hw), batch)
+    mega = jax.jit(lambda v, b: batched_grand_scores_fused(
+        model, v, b["image"], b["label"], b["mask"], use_pallas=True,
+        megakernel=True))(variables, batch)
+    ref = make_grand_step(model, chunk=4)(variables, batch)
+    np.testing.assert_allclose(np.asarray(mega), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_megakernel_requires_pallas_and_masks():
+    """DDT_GRAND_MEGAKERNEL without the Pallas route refuses loudly (a bisect
+    combo must never measure a silently-fallback program), and masked rows
+    score zero like every other path."""
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+    model = create_model("tiny_cnn", 10)
+    batch = _batch(8, 16, seed=10)
+    variables = _init(model, 16)
+    with pytest.raises(ValueError, match="MEGAKERNEL"):
+        batched_grand_scores_fused(model, variables, batch["image"],
+                                   batch["label"], batch["mask"],
+                                   use_pallas=False, megakernel=True)
+    batch["mask"][5:] = 0.0
+    scores = np.asarray(batched_grand_scores_fused(
+        model, variables, batch["image"], batch["label"], batch["mask"],
+        use_pallas=True, megakernel=True))
+    assert (scores[5:] == 0).all() and (scores[:5] > 0).all()
+
+
 def test_fused_bwd_masked_rows_and_refusal():
     """Fused path masks like the two-phase path, shares its coverage guard,
     and refuses the grouping toggles it does not implement."""

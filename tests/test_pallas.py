@@ -235,6 +235,81 @@ class TestCatDotKernel:
         assert not _catdot_ok(18, 18, 128, 16, 16, 128, 1, 1, 2)  # 1x1 conv
 
 
+class TestMegaKernel:
+    """Layout-persistent megakernel: conv input-cotangent backward AND the
+    weight-grad-norm contraction from one launch (interpret mode on CPU),
+    against jax.vjp of the conv + the patch-einsum contraction reference."""
+
+    @staticmethod
+    def _ref(x, g, w, ks, pad):
+        def conv(xx):
+            return jax.lax.conv_general_dilated(
+                xx, w, (1, 1), pad, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        (dx,) = jax.vjp(conv, x)[1](g)
+        ns = TestConvGradNorm._ref(None, x, g, ks, (1, 1), pad)
+        return dx, ns
+
+    # Zoo geometries: stage conv, the PACKED stage-1 64×64 case, a
+    # channel-doubling entry, 1×1, and the WRN-28-10 32²×160 VMEM-margin
+    # geometry the round-5 compile failure was isolated to.
+    @pytest.mark.parametrize("h,c,k,ks,pad,bias", [
+        (8, 16, 16, (3, 3), ((1, 1), (1, 1)), False),
+        (16, 64, 64, (3, 3), ((1, 1), (1, 1)), True),    # pack path fires
+        (10, 128, 64, (3, 3), ((1, 1), (1, 1)), False),
+        (9, 32, 48, (1, 1), ((0, 0), (0, 0)), False),
+        (32, 160, 160, (3, 3), ((1, 1), (1, 1)), False),  # WRN margin case
+    ])
+    def test_matches_vjp_and_contraction(self, h, c, k, ks, pad, bias):
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_bwd_grad_norm_sq_pallas, conv_bwd_norm_eligible)
+        rng = np.random.default_rng(0)
+        ho = h + pad[0][0] + pad[0][1] - ks[0] + 1
+        x = jnp.asarray(rng.normal(size=(10, h, h, c)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(10, ho, ho, k)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(*ks, c, k)).astype(np.float32) * 0.1)
+        assert conv_bwd_norm_eligible(x.shape, g.shape, ks, (1, 1),
+                                      x.dtype.itemsize)
+        dx, ns = conv_bwd_grad_norm_sq_pallas(x, g, w, ks, pad, use_bias=bias,
+                                              interpret=True)
+        rdx, rns = self._ref(x, g, w, ks, pad)
+        if bias:
+            gs = jnp.sum(g.reshape(10, -1, k), axis=1)
+            rns = rns + jnp.sum(gs * gs, axis=-1)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(rdx),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ns), np.asarray(rns),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_eligibility_gates(self):
+        from data_diet_distributed_tpu.ops.pallas_kernels import (
+            conv_bwd_norm_eligible)
+        # Strided convs stay on the two-phase path.
+        assert not conv_bwd_norm_eligible((8, 16, 16, 64), (8, 8, 8, 128),
+                                          (3, 3), (2, 2), 4)
+        # Unit-stride zoo geometry is in.
+        assert conv_bwd_norm_eligible((8, 32, 32, 64), (8, 32, 32, 64),
+                                      (3, 3), (1, 1), 4)
+
+    def test_route_gates(self):
+        """The fused-tap dispatch: stems (tiny F) and Gram-regime layers stay
+        on the plain taps; stage-1/2/3 mains take the megakernel."""
+        from data_diet_distributed_tpu.ops.grand_batched import \
+            _mega_conv_route
+        rec = {"kind": "conv", "kernel_size": (3, 3), "strides": (1, 1),
+               "padding": "SAME", "use_bias": False}
+        x64 = jnp.zeros((8, 32, 32, 64), jnp.float32)
+        g64 = jnp.zeros((8, 32, 32, 64), jnp.float32)
+        assert _mega_conv_route(rec, x64, g64)
+        stem = jnp.zeros((8, 32, 32, 3), jnp.float32)
+        assert not _mega_conv_route(rec, stem, g64)          # tiny F
+        x512 = jnp.zeros((8, 4, 4, 512), jnp.float32)
+        g512 = jnp.zeros((8, 4, 4, 512), jnp.float32)
+        assert not _mega_conv_route(rec, x512, g512)         # Gram regime
+        strided = dict(rec, strides=(2, 2))
+        assert not _mega_conv_route(strided, x64,
+                                    jnp.zeros((8, 16, 16, 128), jnp.float32))
+
+
 class TestBatchNormKernel:
     """Fused stacked BatchNorm grad-norm kernel vs the XLA reduction form."""
 

@@ -1,0 +1,184 @@
+"""Chunked score engine (K score batches per dispatch, ops/scores.make_score_chunk).
+
+The engine's contract mirrors the train chunk's (tests/test_chunked.py): a
+PURE dispatch-count transform — chunked ``score_dataset`` returns scores
+BIT-identical to the per-batch path for every registry method, per-seed
+partials included — while collapsing a full score epoch to one dispatch per
+seed on the resident path.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.data.datasets import load_dataset
+from data_diet_distributed_tpu.data.pipeline import BatchSharder
+from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.ops import scoring as scoring_mod
+from data_diet_distributed_tpu.ops.scoring import (MAX_SCORE_CHUNK_STEPS,
+                                                   ScoreResident,
+                                                   resolve_score_chunk_steps,
+                                                   score_dataset)
+from data_diet_distributed_tpu.parallel.mesh import replicate
+
+
+@pytest.fixture(scope="module")
+def scoring_setup(mesh8):
+    """A 100-example dataset (non-divisible tail at batch 32), tiny_cnn, and
+    two scoring seeds — shared across the method matrix."""
+    ds, _ = load_dataset("synthetic", synthetic_size=100, seed=0)
+    model = create_model("tiny_cnn", ds.num_classes)
+    init = jax.jit(model.init, static_argnames=("train",))
+    seeds = [replicate(init(jax.random.key(s),
+                            np.zeros((1, *ds.images.shape[1:]), np.float32),
+                            train=False), mesh8) for s in range(2)]
+    return ds, model, seeds, BatchSharder(mesh8)
+
+
+# ------------------------------------------------------------ bit-exactness
+
+
+@pytest.mark.parametrize("method", ["el2n", "grand", "grand_last_layer",
+                                    "margin", "grand_vmap"])
+def test_chunked_scores_bit_identical(method, scoring_setup):
+    """Chunked (K=3 over 4 batches — a 3-chunk plus a 1-batch tail, the worst
+    case) and auto (whole epoch, one dispatch) vs per-batch: the returned f32
+    score vectors must be tree-equal to the bit, for every registry method."""
+    ds, model, seeds, sharder = scoring_setup
+    kw = dict(method=method, batch_size=32, sharder=sharder, chunk=4)
+    per_batch = score_dataset(model, seeds, ds, chunk_steps=0, **kw)
+    chunked = score_dataset(model, seeds, ds, chunk_steps=3, **kw)
+    auto = score_dataset(model, seeds, ds, chunk_steps=None, **kw)
+    np.testing.assert_array_equal(per_batch, chunked)
+    np.testing.assert_array_equal(per_batch, auto)
+    assert per_batch.dtype == np.float32 and per_batch.shape == (100,)
+    assert (per_batch != 0).any()
+
+
+def test_chunked_seed_partials_bit_identical(scoring_setup):
+    """on_seed_done receives the same float64 per-seed vectors under either
+    engine — the stage-resume partials a resumed run averages back in must
+    not depend on which engine computed them."""
+    ds, model, seeds, sharder = scoring_setup
+
+    def collect(chunk_steps):
+        got = {}
+        score_dataset(model, seeds, ds, method="el2n", batch_size=32,
+                      sharder=sharder, chunk_steps=chunk_steps,
+                      on_seed_done=lambda k, v: got.__setitem__(k, v.copy()))
+        return got
+
+    per_batch, chunked = collect(0), collect(None)
+    assert set(per_batch) == set(chunked) == {0, 1}
+    for k in per_batch:
+        assert per_batch[k].dtype == np.float64
+        np.testing.assert_array_equal(per_batch[k], chunked[k])
+
+
+def test_chunked_one_dispatch_per_seed(scoring_setup, monkeypatch):
+    """Auto chunking on the resident path collapses a 4-batch epoch to ONE
+    dispatch per seed; K=3 gives ceil(4/3)=2."""
+    ds, model, seeds, sharder = scoring_setup
+    calls = []
+    real = scoring_mod._dispatch_score_chunk
+
+    def counting(chunk_fn, *args):
+        calls.append(args[1].shape[0])   # images block's K
+        return real(chunk_fn, *args)
+
+    monkeypatch.setattr(scoring_mod, "_dispatch_score_chunk", counting)
+    score_dataset(model, seeds, ds, method="el2n", batch_size=32,
+                  sharder=sharder, chunk_steps=None)
+    assert calls == [4, 4]               # one whole-epoch dispatch per seed
+    calls.clear()
+    score_dataset(model, seeds, ds, method="el2n", batch_size=32,
+                  sharder=sharder, chunk_steps=3)
+    assert calls == [3, 1, 3, 1]         # chunk + tail, per seed
+
+
+# ------------------------------------------------- selection / block layout
+
+
+def test_resolve_score_chunk_steps_policy():
+    # Auto: whole epoch on the resident path, clamped.
+    assert resolve_score_chunk_steps(None, 4, True) == 4
+    assert resolve_score_chunk_steps(None, 1000, True) == MAX_SCORE_CHUNK_STEPS
+    # Forced per-batch / explicit size / clamp to the epoch.
+    assert resolve_score_chunk_steps(0, 4, True) == 1
+    assert resolve_score_chunk_steps(1, 4, True) == 1
+    assert resolve_score_chunk_steps(3, 4, True) == 3
+    assert resolve_score_chunk_steps(100, 4, True) == 4
+    # Streaming (non-resident) always falls back.
+    assert resolve_score_chunk_steps(None, 4, False) == 1
+    assert resolve_score_chunk_steps(8, 4, False) == 1
+
+
+def test_score_resident_composition():
+    """ScoreResident must reproduce iterate_batches' epoch composition:
+    dataset order, row-0 tail image padding, zeroed tail labels, mask 0,
+    remainder tail block."""
+    from data_diet_distributed_tpu.data.pipeline import iterate_batches
+    ds, _ = load_dataset("synthetic", synthetic_size=100, seed=0)
+    res = ScoreResident(ds, 32)
+    assert (res.nb, res.batch_size, res.n) == (4, 32, 100)
+    want = list(iterate_batches(ds, 32, shuffle=False))
+    got_imgs = np.asarray(res.images)
+    got_labels = np.asarray(res.labels)
+    got_mask = np.asarray(res.mask)
+    for j, b in enumerate(want):
+        np.testing.assert_array_equal(got_imgs[j], b["image"])
+        np.testing.assert_array_equal(got_labels[j], b["label"])
+        np.testing.assert_array_equal(got_mask[j], b["mask"])
+    blocks = list(res.blocks(3))
+    assert [blk[0].shape[0] for blk in blocks] == [3, 1]
+    # The whole-epoch block is the resident arrays themselves (no copy).
+    (full,) = list(res.blocks(4))
+    assert full[0] is res.images
+
+
+def test_custom_score_step_forces_per_batch(scoring_setup, monkeypatch):
+    """A caller-supplied score_step must keep the per-batch engine — the
+    chunk compiles its own program and would silently ignore the override."""
+    from data_diet_distributed_tpu.ops.scores import make_score_step
+    ds, model, seeds, sharder = scoring_setup
+    monkeypatch.setattr(
+        scoring_mod, "_dispatch_score_chunk",
+        lambda *a: pytest.fail("chunked engine ran despite custom step"))
+    step = make_score_step(model, "el2n", sharder.mesh)
+    scores = score_dataset(model, seeds, ds, method="el2n", batch_size=32,
+                           sharder=sharder, chunk_steps=8, score_step=step)
+    assert scores.shape == (100,)
+
+
+def test_score_chunk_steps_config_validation():
+    with pytest.raises(ValueError, match="score.chunk_steps"):
+        load_config(None, ["score.chunk_steps=-1"])
+    assert load_config(None, ["score.chunk_steps=0"]).score.chunk_steps == 0
+    assert load_config(None, []).score.chunk_steps is None
+
+
+def test_compute_scores_passes_chunk_steps(tmp_path, mesh8, monkeypatch):
+    """The config knob reaches score_dataset (the production wiring)."""
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    from data_diet_distributed_tpu.train import loop as loop_mod
+
+    seen = {}
+    real = score_dataset
+
+    def spy(*args, **kwargs):
+        seen["chunk_steps"] = kwargs.get("chunk_steps", "missing")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(loop_mod, "score_dataset", spy)
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=64",
+        "data.batch_size=32", "model.arch=tiny_cnn",
+        "score.pretrain_epochs=0", "score.batch_size=32",
+        "score.chunk_steps=2", f"train.checkpoint_dir={tmp_path}/ckpt"])
+    train_ds, _ = load_dataset("synthetic", synthetic_size=64, seed=0)
+    cfg.model.num_classes = train_ds.num_classes
+    loop_mod.compute_scores(cfg, train_ds, mesh=mesh8,
+                            sharder=BatchSharder(mesh8),
+                            logger=MetricsLogger(None, echo=False))
+    assert seen["chunk_steps"] == 2

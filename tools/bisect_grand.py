@@ -30,27 +30,40 @@ import sys
 # "baseline" must always measure the all-off program.
 _ALL_OFF = {f"DDT_GRAND_{k}": "0" for k in
             ("GROUP_CONV", "GROUP_BN", "BN_KERNEL", "CATDOT", "STEM_XLA",
-             "FUSED")}
+             "FUSED", "MEGAKERNEL")}
 
 
 def _combo(*on: str) -> dict:
     return {**_ALL_OFF, **{f"DDT_GRAND_{k}": "1" for k in on}}
 
 
+# (name, env, extra bench args). The score-chunk arms pin the dispatch-free
+# score engine explicitly against the per-batch engine on the SAME kernel
+# composition — its win is dispatch-count, orthogonal to the kernel toggles,
+# so two arms on the current default composition suffice; the remaining
+# combos run the bench's default (auto) chunking so kernel effects are
+# compared like-for-like.
 COMBOS = [
-    ("baseline", _combo()),
-    ("catdot", _combo("CATDOT")),
-    ("bn_kernel", _combo("BN_KERNEL")),
-    ("bn_kernel+catdot", _combo("BN_KERNEL", "CATDOT")),
-    ("bn_kernel+group_bn", _combo("BN_KERNEL", "GROUP_BN")),
-    ("group_conv", _combo("GROUP_CONV")),
-    ("stem_xla", _combo("STEM_XLA")),
-    ("bn_kernel+catdot+stem_xla", _combo("BN_KERNEL", "CATDOT", "STEM_XLA")),
-    ("fused", _combo("FUSED")),
-    ("fused+stem_xla", _combo("FUSED", "STEM_XLA")),
+    ("baseline", _combo(), []),
+    ("catdot", _combo("CATDOT"), []),
+    ("bn_kernel", _combo("BN_KERNEL"), []),
+    ("bn_kernel+catdot", _combo("BN_KERNEL", "CATDOT"), []),
+    ("bn_kernel+group_bn", _combo("BN_KERNEL", "GROUP_BN"), []),
+    ("group_conv", _combo("GROUP_CONV"), []),
+    ("stem_xla", _combo("STEM_XLA"), []),
+    ("bn_kernel+catdot+stem_xla", _combo("BN_KERNEL", "CATDOT", "STEM_XLA"),
+     []),
+    ("fused", _combo("FUSED"), []),
+    ("fused+stem_xla", _combo("FUSED", "STEM_XLA"), []),
+    ("megakernel", _combo("MEGAKERNEL"), []),
+    ("megakernel+stem_xla", _combo("MEGAKERNEL", "STEM_XLA"), []),
+    # The chunk A/B pair: "stem_xla" (above) already measures auto chunking
+    # (the bench default), so the per-batch arm is the only extra run needed.
+    ("stem_xla+chunk0", _combo("STEM_XLA"), ["--chunk", "0"]),
 ]
 
-FAST = ("baseline", "stem_xla", "fused", "fused+stem_xla")
+FAST = ("baseline", "stem_xla", "megakernel", "megakernel+stem_xla",
+        "stem_xla+chunk0")
 
 
 def main():
@@ -58,7 +71,10 @@ def main():
     ap.add_argument("--size", type=int, default=8192)
     ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument("--arch", default="resnet18")
-    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="vmap(grad) chunk forwarded as bench --grand-chunk "
+                         "(the score-chunk engine arms carry their own "
+                         "--chunk in COMBOS)")
     ap.add_argument("--timeout", type=int, default=900)
     ap.add_argument("--fast", action="store_true",
                     help="curated 4-config race (expected winners only)")
@@ -66,19 +82,19 @@ def main():
     args = ap.parse_args()
     bench = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench.py")
-    combos = [(n, e) for n, e in COMBOS if not args.fast or n in FAST]
+    combos = [c for c in COMBOS if not args.fast or c[0] in FAST]
     results = []
-    for name, env in combos:
+    for name, env, extra_args in combos:
         cmd = [sys.executable, bench, "--size", str(args.size),
                "--batch", str(args.batch), "--arch", args.arch,
-               "--chunk", str(args.chunk)]
+               "--grand-chunk", str(args.chunk)] + extra_args
         try:
             out = subprocess.run(
                 cmd, env={**os.environ, **env}, capture_output=True,
                 text=True, timeout=args.timeout)
             lines = [ln for ln in out.stdout.splitlines()
                      if ln.startswith("{")]
-            rec = {"combo": name, "env": env}
+            rec = {"combo": name, "env": env, "args": extra_args}
             if lines:
                 try:
                     rec.update(json.loads(lines[-1]))

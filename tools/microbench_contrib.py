@@ -69,10 +69,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--no-mega", action="store_true",
+                    help="skip the megakernel arm (pallas/xla A/B only)")
     args = ap.parse_args()
     b = args.batch
     dt = jnp.dtype(args.dtype)
     rng = np.random.default_rng(0)
+    from data_diet_distributed_tpu.ops.pallas_kernels import \
+        conv_bwd_grad_norm_sq_pallas
     for name, xh, xc, gh, gc, k, s in GEOMS:
         x = jnp.asarray(rng.standard_normal((b, xh, xh, xc)), dt)
         g = jnp.asarray(rng.standard_normal((b, gh, gh, gc)), dt)
@@ -85,6 +89,24 @@ def main():
                 repeated(partial(gb._conv_contrib, rec,
                                  use_pallas=use_pallas)), x, g)
             row.append(f"{label} {t*1e3:7.2f} ms {flops/t/1e12:6.1f} TF/s")
+        # Megakernel arm (eligible geometries): contraction + the layer's
+        # input-cotangent backward in one launch, so per-layer wins/losses
+        # are attributable BEFORE an end-to-end bisection. Its TF/s uses the
+        # combined FLOPs (contraction + transposed-conv dx — roughly 2× the
+        # contraction) and is comparable only mega-vs-mega; the honest A/B
+        # against the pallas column is WALL TIME vs (pallas + the XLA conv
+        # backward this kernel subsumes).
+        if not args.no_mega and gb._mega_conv_route(rec, x, g):
+            wgt = jnp.asarray(rng.standard_normal((k, k, xc, gc)) * 0.1, dt)
+            pad = gb._explicit_padding("SAME", x, g, rec)
+
+            def mega(x_, g_, wgt=wgt, pad=pad):
+                dx, ns = conv_bwd_grad_norm_sq_pallas(
+                    x_, g_, wgt, (k, k), pad, use_bias=False)
+                return jnp.sum(dx.astype(jnp.float32)) + jnp.sum(ns)
+            t = per_iter_seconds(repeated(mega), x, g)
+            mflops = flops + 2 * b * xh * xh * (k * k * gc) * xc  # + dx
+            row.append(f"mega {t*1e3:7.2f} ms {mflops/t/1e12:6.1f} TF/s")
         print("  |  ".join(row), flush=True)
 
 

@@ -1,23 +1,24 @@
-"""Per-dispatch overhead of the training step, by difference quotient.
+"""Per-dispatch overhead of the chunked engines, by difference quotient.
 
-The chunked execution engine (``train/steps.make_train_chunk``) exists because
-every dispatch on this repo's relay-attached hosts costs ~25 ms of host↔device
-latency. This tool MEASURES that tax through the production chunk program
-itself, the same way ``tools/profile_grand.py`` times kernels: one dispatch of
-a K-step chunk costs ``t(K) = overhead + K * t_step``, so two chunk lengths
-give both unknowns without ever trusting a host-side timer around a single
-op::
+The chunked execution engines (``train/steps.make_train_chunk`` for training,
+``ops/scores.make_score_chunk`` for scoring) exist because every dispatch on
+this repo's relay-attached hosts costs ~25 ms of host↔device latency. This
+tool MEASURES that tax through the production chunk programs themselves, the
+same way ``tools/profile_grand.py`` times kernels: one dispatch of a K-step
+chunk costs ``t(K) = overhead + K * t_step``, so two chunk lengths give both
+unknowns without ever trusting a host-side timer around a single op::
 
     t_step   = (t(K_long) - t(1)) / (K_long - 1)     # dispatch tax cancels
     overhead = t(1) - t_step
 
 From those it derives the chunk size at which the dispatch tax drops below
 ``--frac`` of compute — the measurement behind
-``train/loop.DEFAULT_CHUNK_STEPS``.
+``train/loop.DEFAULT_CHUNK_STEPS`` and the recommended ``score.chunk_steps``.
 
-Run: ``python tools/profile_dispatch.py [--arch resnet18] [--batch 1024]
-[--k-long 16] [--frac 0.05]`` (add ``JAX_PLATFORMS=cpu`` for the CPU lane —
-the numbers then describe CPU dispatch, useful only for relative sanity).
+Run: ``python tools/profile_dispatch.py [--task train|score] [--arch resnet18]
+[--batch 1024] [--method grand] [--k-long 16] [--frac 0.05]`` (add
+``JAX_PLATFORMS=cpu`` for the CPU lane — the numbers then describe CPU
+dispatch, useful only for relative sanity).
 """
 
 from __future__ import annotations
@@ -36,7 +37,8 @@ from data_diet_distributed_tpu.config import load_config  # noqa: E402
 from data_diet_distributed_tpu.data.datasets import load_dataset  # noqa: E402
 from data_diet_distributed_tpu.data.pipeline import (BatchSharder,  # noqa: E402
                                                      ResidentBatches)
-from data_diet_distributed_tpu.models import create_model_from_cfg  # noqa: E402
+from data_diet_distributed_tpu.models import (create_model,  # noqa: E402
+                                              create_model_from_cfg)
 from data_diet_distributed_tpu.parallel.mesh import (make_mesh,  # noqa: E402
                                                      place_state)
 from data_diet_distributed_tpu.train.loop import MAX_CHUNK_STEPS  # noqa: E402
@@ -44,25 +46,32 @@ from data_diet_distributed_tpu.train.state import create_train_state  # noqa: E4
 from data_diet_distributed_tpu.train.steps import make_train_chunk  # noqa: E402
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--arch", default="resnet18")
-    ap.add_argument("--batch", type=int, default=1024)
-    ap.add_argument("--size", type=int, default=None,
-                    help="synthetic dataset size (default: --batch)")
-    ap.add_argument("--k-long", type=int, default=16,
-                    help="long chunk length for the difference quotient")
-    ap.add_argument("--reps", type=int, default=3,
-                    help="timing repetitions (min is reported)")
-    ap.add_argument("--frac", type=float, default=0.05,
-                    help="target dispatch-tax fraction for the recommended "
-                         "chunk size")
-    ap.add_argument("--no-half", action="store_true",
-                    help="fp32 compute (CPU-lane runs)")
-    args = ap.parse_args()
-    if args.k_long < 2:
-        raise SystemExit("--k-long must be >= 2 for a difference quotient")
+def _report(args, label: str, unit_name: str, t1: float, tl: float,
+            batch: int, clamp: int) -> None:
+    t_step = (tl - t1) / (args.k_long - 1)
+    overhead = t1 - t_step
+    plural = "es" if unit_name.endswith("ch") else "s"
+    print(f"task={args.task} arch={args.arch} batch={batch} "
+          f"devices={len(jax.devices())} ({jax.devices()[0].platform})")
+    print(f"t(1)        = {t1 * 1e3:8.2f} ms   (one dispatch, one {unit_name})")
+    print(f"t({args.k_long:<2})       = {tl * 1e3:8.2f} ms   "
+          f"(one dispatch, {args.k_long} {unit_name}{plural})")
+    print(f"per-{unit_name:<7} = {t_step * 1e3:8.2f} ms   "
+          f"({batch / max(t_step, 1e-9):9.0f} ex/s device-side)")
+    print(f"per-dispatch overhead = {overhead * 1e3:.2f} ms "
+          f"({100 * overhead / max(t1, 1e-9):.0f}% of a single-{unit_name} "
+          "dispatch)")
+    if overhead <= 0 or t_step <= 0:
+        print(f"overhead within measurement noise — chunking buys nothing "
+              f"here; {label}=1 is fine")
+        return
+    rec = int(np.ceil(overhead / (args.frac * t_step)))
+    rec = max(1, min(rec, clamp))
+    print(f"recommended {label} >= {rec} "
+          f"(dispatch tax <= {args.frac:.0%} of compute; clamp {clamp})")
 
+
+def profile_train(args) -> None:
     size = args.size or args.batch
     cfg = load_config(None, [
         "data.dataset=synthetic", f"data.synthetic_size={size}",
@@ -106,27 +115,96 @@ def main() -> None:
         t1 = min(t1, dt)
         dt, state = dispatch(state, args.k_long)
         tl = min(tl, dt)
+    _report(args, "train.chunk_steps", "step", t1, tl, batch, MAX_CHUNK_STEPS)
 
-    t_step = (tl - t1) / (args.k_long - 1)
-    overhead = t1 - t_step
-    print(f"arch={args.arch} batch={batch} devices={len(jax.devices())} "
-          f"({jax.devices()[0].platform})")
-    print(f"t(1)        = {t1 * 1e3:8.2f} ms   (one dispatch, one step)")
-    print(f"t({args.k_long:<2})       = {tl * 1e3:8.2f} ms   "
-          f"(one dispatch, {args.k_long} steps)")
-    print(f"per-step    = {t_step * 1e3:8.2f} ms   "
-          f"({batch / max(t_step, 1e-9):9.0f} ex/s device-side)")
-    print(f"per-dispatch overhead = {overhead * 1e3:.2f} ms "
-          f"({100 * overhead / max(t1, 1e-9):.0f}% of a single-step dispatch)")
-    if overhead <= 0 or t_step <= 0:
-        print("overhead within measurement noise — chunking buys nothing "
-              "here; train.chunk_steps=1 is fine")
-        return
-    rec = int(np.ceil(overhead / (args.frac * t_step)))
-    rec = max(1, min(rec, MAX_CHUNK_STEPS))
-    print(f"recommended train.chunk_steps >= {rec} "
-          f"(dispatch tax <= {args.frac:.0%} of compute; clamp "
-          f"{MAX_CHUNK_STEPS})")
+
+def profile_score(args) -> None:
+    """The same difference-quotient methodology through the production SCORE
+    chunk program (``ops/scores.make_score_chunk``): one dispatch scans K
+    score batches off the pre-sharded resident blocks, the stacked score
+    fetch is the barrier, and the recommended ``score.chunk_steps`` falls
+    out."""
+    import jax.numpy as jnp
+
+    from data_diet_distributed_tpu.ops.scores import make_score_chunk
+    from data_diet_distributed_tpu.ops.scoring import (MAX_SCORE_CHUNK_STEPS,
+                                                       ScoreResident)
+
+    size = args.size or args.k_long * args.batch
+    mesh = make_mesh(None)
+    sharder = BatchSharder.flat(mesh)
+    batch = sharder.global_batch_size_for(args.batch)
+    train_ds, _ = load_dataset("synthetic", synthetic_size=size, seed=0)
+    model = create_model(args.arch, train_ds.num_classes,
+                         half_precision=not args.no_half)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0),
+        np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
+
+    multi = mesh.size > 1
+    if multi:
+        from data_diet_distributed_tpu.parallel.mesh import replicate
+        variables = replicate(variables, mesh)
+    resident = ScoreResident(train_ds, batch, mesh if multi else None)
+    if resident.nb < args.k_long:
+        # A short long-dispatch silently corrupts the difference quotient
+        # (t(K) would really be t(nb) while the divisor stays K-1).
+        raise SystemExit(
+            f"--size {size} gives only {resident.nb} batches at batch "
+            f"{batch}; the difference quotient needs >= --k-long "
+            f"({args.k_long}) — raise --size or lower --k-long")
+    chunk_fn = make_score_chunk(model, args.method, mesh if multi else None,
+                                chunk=args.grand_chunk, use_pallas=None)
+
+    def dispatch(k: int) -> float:
+        imgs = resident.images[:k]
+        labs = resident.labels[:k]
+        mask = resident.mask[:k]
+        t0 = time.perf_counter()
+        out = chunk_fn(variables, imgs, labs, mask)
+        float(jax.device_get(jnp.sum(out)))   # the fetch is the barrier
+        return time.perf_counter() - t0
+
+    for k in (1, args.k_long):            # compile both program lengths
+        dispatch(k)
+    t1 = tl = float("inf")
+    for _ in range(args.reps):
+        t1 = min(t1, dispatch(1))
+        tl = min(tl, dispatch(args.k_long))
+    _report(args, "score.chunk_steps", "batch", t1, tl, batch,
+            MAX_SCORE_CHUNK_STEPS)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", default="train", choices=["train", "score"],
+                    help="which chunk program to profile: the train chunk "
+                         "(default) or the score chunk")
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--method", default="grand",
+                    help="score task: scoring method (grand | el2n | ...)")
+    ap.add_argument("--grand-chunk", type=int, default=64,
+                    help="score task: vmap(grad) chunk for grand_vmap")
+    ap.add_argument("--size", type=int, default=None,
+                    help="synthetic dataset size (default: --batch for "
+                         "train, k_long*batch for score)")
+    ap.add_argument("--k-long", type=int, default=16,
+                    help="long chunk length for the difference quotient")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timing repetitions (min is reported)")
+    ap.add_argument("--frac", type=float, default=0.05,
+                    help="target dispatch-tax fraction for the recommended "
+                         "chunk size")
+    ap.add_argument("--no-half", action="store_true",
+                    help="fp32 compute (CPU-lane runs)")
+    args = ap.parse_args()
+    if args.k_long < 2:
+        raise SystemExit("--k-long must be >= 2 for a difference quotient")
+    if args.task == "score":
+        profile_score(args)
+    else:
+        profile_train(args)
 
 
 if __name__ == "__main__":

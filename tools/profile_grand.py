@@ -72,15 +72,30 @@ def main():
     ap.add_argument("--size", type=int, default=32)
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--no-pallas", action="store_true")
+    ap.add_argument("--megakernel", action="store_true",
+                    help="ALSO decompose the DDT_GRAND_MEGAKERNEL pass: full "
+                         "megakernel-pass time, per-geometry isolated "
+                         "megakernel launches, and the residual bounds on "
+                         "the remaining kernel-boundary term — so the "
+                         "round-5 ~26 ms composition overhead is "
+                         "RE-measured under the megakernel, not assumed "
+                         "gone")
     args = ap.parse_args()
-    if gb.FUSED_BWD:
+    if gb.FUSED_BWD or gb.MEGAKERNEL:
         # This tool times the TWO-PHASE program (it calls
-        # batched_grand_scores directly); under DDT_GRAND_FUSED=1 every
-        # reported number would describe a program the operator isn't running.
+        # batched_grand_scores directly); under DDT_GRAND_FUSED=1 /
+        # DDT_GRAND_MEGAKERNEL=1 every reported number would describe a
+        # program the operator isn't running. (--megakernel profiles the
+        # megakernel pass EXPLICITLY, alongside the two-phase baseline.)
         raise SystemExit("profile_grand times the two-phase path; unset "
-                         "DDT_GRAND_FUSED (fused-path A/Bs live in bench.py / "
+                         "DDT_GRAND_FUSED/DDT_GRAND_MEGAKERNEL (pass "
+                         "--megakernel to decompose the megakernel program "
+                         "explicitly; whole-pass A/Bs live in bench.py / "
                          "tools/bisect_grand.py)")
     use_pallas = not args.no_pallas
+    if args.megakernel and args.no_pallas:
+        raise SystemExit("--megakernel requires the Pallas route "
+                         "(drop --no-pallas)")
 
     model = create_model(args.arch, args.classes, half_precision=True)
     rng = jax.random.key(0)
@@ -182,6 +197,63 @@ def main():
               f"{name:<32} {shapes} {tfs}")
     print(f"\nsum of isolated contractions: {tot*1e3:.2f} ms "
           f"(full-pass contraction share {(t_full-t_fb)*1e3:.2f} ms)")
+    print(f"two-phase composition residual (full - fwd+bwd - isolated): "
+          f"{(t_full - t_fb - tot)*1e3:.2f} ms")
+
+    if not args.megakernel:
+        return
+
+    # ---- megakernel decomposition: re-measure the boundary term ----
+    from data_diet_distributed_tpu.ops.pallas_kernels import \
+        conv_bwd_grad_norm_sq_pallas
+
+    def mega_full(i):
+        return gb.batched_grand_scores_fused(model, variables, i, label, mask,
+                                             use_pallas=True, megakernel=True)
+    t_mega = per_iter_seconds(repeated(mega_full), img)
+
+    def fwd_only(i):
+        from data_diet_distributed_tpu.ops.scores import cross_entropy as ce
+        return ce(model.apply(variables, i, train=False), label) * mask
+    t_fwd = per_iter_seconds(repeated(fwd_only), img)
+    print(f"\n== megakernel (DDT_GRAND_MEGAKERNEL=1) ==")
+    print(f"forward only             : {t_fwd*1e3:8.2f} ms")
+    print(f"full megakernel pass     : {t_mega*1e3:8.2f} ms   "
+          f"{args.batch/t_mega:9.0f} ex/s   (two-phase {t_full*1e3:.2f} ms)")
+
+    mega_tot = other_tot = 0.0
+    for (kind, xs, gs, _, _), grp in groups.items():
+        rec, x, g, count = grp["rec"], grp["x"], grp["g"], grp["count"]
+        if kind == "conv" and gb._mega_conv_route(rec, x, g):
+            wgt = gb._leaf(variables["params"], rec["path"], "kernel")
+            pad = gb._explicit_padding(rec["padding"], x, g, rec)
+
+            def mega_layer(x_, g_, rec=rec, wgt=wgt, pad=pad):
+                dx, ns = conv_bwd_grad_norm_sq_pallas(
+                    x_, g_, wgt, tuple(rec["kernel_size"]), pad,
+                    use_bias=rec["use_bias"])
+                return jnp.sum(dx.astype(jnp.float32)) + jnp.sum(ns)
+            t = per_iter_seconds(repeated(mega_layer), x, g)
+            mega_tot += t * count
+            print(f"{t*count*1e3:8.2f} ms  n={count}  mega  "
+                  f"{grp['name']:<32} x{tuple(x.shape[1:])} "
+                  f"g{tuple(g.shape[1:])}", flush=True)
+        else:
+            # Ineligible layers keep their two-phase contraction cost.
+            t = next(r[0] for r in rows if r[2] == grp["name"])
+            other_tot += t
+    print(f"sum isolated megakernel launches: {mega_tot*1e3:.2f} ms; "
+          f"non-mega contractions: {other_tot*1e3:.2f} ms")
+    # Two bounds, both printed, neither assumed: the isolated megakernel
+    # rows CONTAIN the conv backward (dx) work that t_fb also contains, so
+    # subtracting both under-counts; subtracting only the forward leaves the
+    # non-conv backward inside the residual, over-counting.
+    lower = t_mega - t_fb - mega_tot - other_tot
+    upper = t_mega - t_fwd - mega_tot - other_tot
+    print(f"megakernel boundary-term bounds: "
+          f"lower {lower*1e3:.2f} ms (dx double-counted) / "
+          f"upper {upper*1e3:.2f} ms (includes non-conv backward) — "
+          f"vs two-phase residual {(t_full - t_fb - tot)*1e3:.2f} ms")
 
 
 if __name__ == "__main__":
