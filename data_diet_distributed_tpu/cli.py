@@ -71,19 +71,21 @@ def main(argv: list[str] | None = None) -> int:
     if monitor:
         monitor.start()
     logger = MetricsLogger(cfg.obs.metrics_path)
-    from .obs import emit_run_summary, trace
+    from .obs import emit_run_summary
     from .obs.session import ObsSession
     preempted: Preempted | None = None
     final: dict | None = None
     exit_class = "ok"
     mono0 = time.perf_counter()
     # ObsSession: build + install the unified observability layer — trace
-    # spans, metrics registry, per-rank heartbeats, fault flight recorder —
-    # for the run's duration (entered after multihost init: per-rank paths).
-    with ObsSession(cfg) as obs:
+    # spans, metrics registry, per-rank heartbeats, fault flight recorder,
+    # XLA compiled-program introspector — for the run's duration (entered
+    # after multihost init: per-rank paths). obs.profile_dir's capture is no
+    # longer a whole-run wrap here: the epoch driver owns it as a bounded
+    # steady-state window per stage (obs/profiler.ProfileWindow).
+    with ObsSession(cfg, logger=logger) as obs:
         try:
-            with trace(cfg.obs.profile_dir), \
-                    tracing.span("run", cat="run", command=command):
+            with tracing.span("run", cat="run", command=command):
                 final = _dispatch(command, cfg, logger)
         except Preempted as p:
             # Clean preemption exit: the final checkpoint is durable and the
@@ -106,9 +108,11 @@ def main(argv: list[str] | None = None) -> int:
             try:
                 if obs.registry is not None:
                     logger.log("metrics", **obs.registry.snapshot())
-                emit_run_summary(logger, wall_s=time.perf_counter() - mono0,
-                                 exit_class=exit_class, command=command,
-                                 final=final, registry=obs.registry)
+                summary = emit_run_summary(
+                    logger, wall_s=time.perf_counter() - mono0,
+                    exit_class=exit_class, command=command,
+                    final=final, registry=obs.registry)
+                _append_perf_ledger(cfg, command, summary)
             except Exception as exc:   # noqa: BLE001
                 print(f"[obs] run_summary emission failed: {exc!r}",
                       file=sys.stderr, flush=True)
@@ -154,6 +158,50 @@ def main(argv: list[str] | None = None) -> int:
             except Exception as exc:  # plots are best-effort; the run succeeded
                 print(f"[plots] rendering failed: {exc!r}", flush=True)
     return 0
+
+
+def _append_perf_ledger(cfg: Config, command: str, summary: dict) -> None:
+    """One ``{"kind": "perf_history"}`` record per run into the append-only
+    ledger (``obs.perf_ledger``; off when None) — the perf-regression
+    sentry's (``tools/perf_sentry.py``) input. Rank-0 only, best-effort by
+    contract: a full disk must not change the run's outcome.
+
+    The headline value is the run's wall seconds (every command has one);
+    throughput/MFU/accuracy ride along when the run produced them, and the
+    geometry block is the sentry's grouping key — runs are only ever
+    compared against runs of the same shape."""
+    if not cfg.obs.perf_ledger:
+        return
+    import jax
+    if jax.process_index() != 0:
+        return
+    try:
+        import time as _time
+
+        from .utils.io import atomic_append_jsonl
+        final = summary.get("final") or {}
+        rec = {
+            "kind": "perf_history", "ts": round(_time.time(), 3),
+            "source": "cli", "metric": f"cli_{command}_wall_s",
+            "value": summary.get("wall_s"), "unit": "seconds",
+            "exit_class": summary.get("exit_class"),
+            "backend": jax.default_backend(),
+            "n_devices": len(jax.devices()),
+            "geometry": {"dataset": cfg.data.dataset,
+                         "arch": cfg.model.arch,
+                         "batch": cfg.data.batch_size,
+                         "epochs": cfg.train.num_epochs,
+                         "method": cfg.score.method},
+        }
+        for k in ("examples_per_s", "final_test_accuracy", "total_wall_s"):
+            if isinstance(final.get(k), (int, float)):
+                rec[k] = final[k]
+        if "mfu" in summary:
+            rec["mfu"] = summary["mfu"]
+        atomic_append_jsonl(cfg.obs.perf_ledger, rec)
+    except Exception as exc:   # noqa: BLE001 — ledger is observability, not outcome
+        print(f"[obs] perf ledger append failed: {exc!r}", file=sys.stderr,
+              flush=True)
 
 
 def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> dict | None:

@@ -312,6 +312,25 @@ class ObsConfig:
     flightrec: bool = True
     flightrec_capacity: int = 256
     flightrec_dir: str | None = None
+    # XLA compiled-program introspection (obs/xla.py): once per (jitted
+    # factory, geometry), harvest cost_analysis (flops, bytes accessed) +
+    # memory_analysis (arg/output/temp/peak-estimate bytes) + compile
+    # wall-time into {"kind": "xla_program"} JSONL records and xla_* registry
+    # gauges, derive MFU at epoch boundaries, and poll device.memory_stats()
+    # watermarks at chunk boundaries (hbm_* gauges + a flight-recorder trail
+    # on peak jumps >= hbm_jump_frac). Backends returning empty/partial
+    # analysis degrade to null fields — never a crash.
+    xla_introspect: bool = True
+    hbm_jump_frac: float = 0.10
+    # profile_dir's automatic capture (obs/profiler.ProfileWindow): a
+    # steady-state window of this many chunk dispatches per pipeline stage
+    # (the compile epoch is skipped), one capture per stage tag.
+    profile_window_chunks: int = 8
+    # Append-only perf-history ledger (JSONL; tools/perf_sentry.py compares
+    # runs across time): every run appends one {"kind": "perf_history"}
+    # record at exit. None = off (bench.py keeps its own default ON — the
+    # bench IS the official perf record).
+    perf_ledger: str | None = None
 
 
 @dataclass
@@ -421,6 +440,13 @@ class Config:
             raise ValueError(
                 f"obs.flightrec_capacity must be >= 1, got "
                 f"{o.flightrec_capacity}")
+        if o.profile_window_chunks < 1:
+            raise ValueError(
+                f"obs.profile_window_chunks must be >= 1, got "
+                f"{o.profile_window_chunks}")
+        if o.hbm_jump_frac <= 0:
+            raise ValueError(
+                f"obs.hbm_jump_frac must be > 0, got {o.hbm_jump_frac}")
         return self
 
 

@@ -1,16 +1,18 @@
-from . import flightrec, heartbeat, registry, tracing
+from . import flightrec, heartbeat, registry, tracing, xla
 from .flightrec import FlightRecorder
 from .heartbeat import Heartbeat
 from .metrics import MetricsLogger, emit_run_summary
 from .monitor import ResourceMonitor, sample_devices
 from .plots import plot_metrics, plot_scores, plot_utilization
-from .profiler import StepTimer, trace
+from .profiler import ProfileWindow, StepTimer, trace
 from .registry import MetricsRegistry
 from .session import ObsSession
 from .tracing import Tracer
+from .xla import HbmMonitor, XlaIntrospector
 
 __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
            "trace", "plot_metrics", "plot_scores", "plot_utilization",
            "Tracer", "MetricsRegistry", "Heartbeat", "FlightRecorder",
            "ObsSession", "emit_run_summary", "tracing", "registry",
-           "heartbeat", "flightrec"]
+           "heartbeat", "flightrec", "xla", "XlaIntrospector", "HbmMonitor",
+           "ProfileWindow"]

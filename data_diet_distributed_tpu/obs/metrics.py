@@ -114,6 +114,18 @@ def emit_run_summary(logger: MetricsLogger, *, wall_s: float, exit_class: str,
         stage_s = registry.stage_seconds()
         if stage_s:
             record["stage_s"] = stage_s
+    from . import xla as obs_xla
+    intro = obs_xla.current()
+    if intro is not None and intro.programs:
+        # Compiled-program introspection block: per-program flops / bytes /
+        # compile wall / peak-bytes estimate, plus the MFU gauges derived
+        # from them — the terminal event carries the numbers a perf claim
+        # about this run would cite.
+        record["xla"] = intro.summary()
+        if registry is not None:
+            mfu = registry.snapshot()["gauges"].get("mfu")
+            if mfu is not None:
+                record["mfu"] = mfu
     if final:
         record["final"] = {k: v for k, v in final.items() if v is not None}
     logger.log("run_summary", **record)

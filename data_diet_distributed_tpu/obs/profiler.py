@@ -3,11 +3,21 @@
 The reference has no profiler at all (SURVEY §5.1: coarse wall-clock to
 ``runtime_log.txt`` only). Wrap any region in ``trace(cfg.obs.profile_dir)`` to get a
 full XLA/TPU trace: per-op HLO timing, HBM usage, ICI collective overlap.
+
+``ProfileWindow`` is the AUTOMATIC version the epoch driver wires from
+``obs.profile_dir``: instead of profiling a whole run (minutes of trace, the
+compile epoch drowning the steady state), it captures a bounded window of
+``obs.profile_window_chunks`` chunk dispatches from the first STEADY epoch of
+each pipeline stage — skipping the compile epoch, one capture per stage tag
+per process (``jax.profiler`` cannot nest and a multi-seed pretrain would
+otherwise re-capture per seed).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import re
 
 import jax
 
@@ -22,6 +32,99 @@ def trace(profile_dir: str | None):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class ProfileWindow:
+    """Steady-state ``jax.profiler`` window over one fit's chunk dispatches.
+
+    Created per ``fit`` (when ``obs.profile_dir`` is set); the loop calls
+    ``tick(epoch)`` before each chunk/step dispatch and ``epoch_end(epoch)``
+    /``close()`` on the way out. The window targets the first epoch past the
+    compile epoch (``start_epoch + 1``; a single-epoch fit captures epoch 0
+    but skips its first — compile-carrying — dispatch), starts the profiler
+    at the target's first eligible tick, and stops after ``window_chunks``
+    dispatches. Captures land in ``<profile_dir>/<sanitized tag>/`` so each
+    stage's trace is its own TensorBoard/Perfetto run.
+
+    Process-wide guards (class state, reset by ``reset()``): one capture per
+    stage tag, at most ``MAX_CAPTURES`` captures total (a 10-seed pretrain
+    names a fresh tag per seed — profiling every one of them would tax the
+    run it observes), and never two active captures (``jax.profiler`` cannot
+    nest).
+    """
+
+    MAX_CAPTURES = 4
+    _captured_tags: set[str] = set()
+    _active: "ProfileWindow | None" = None
+
+    def __init__(self, profile_dir: str, tag: str, *, start_epoch: int,
+                 num_epochs: int, window_chunks: int = 8):
+        self.dir = os.path.join(profile_dir,
+                                re.sub(r"[^a-zA-Z0-9_.-]", "_", tag) or "run")
+        self.tag = tag
+        self.window_chunks = max(1, int(window_chunks))
+        single = num_epochs - start_epoch <= 1
+        self.target_epoch = start_epoch if single else start_epoch + 1
+        self._skip = 1 if single else 0   # epoch 0's first dispatch compiles
+        self._started = False
+        self._done = tag in ProfileWindow._captured_tags
+        self._ticks = 0
+
+    @classmethod
+    def reset(cls) -> None:
+        """Clear the process-wide capture bookkeeping (tests run many fits).
+        Stop-then-clear: ``_stop`` records its tag into ``_captured_tags``,
+        so clearing first would let a still-active window repopulate the
+        fresh set and block the next run's capture of that stage."""
+        if cls._active is not None:
+            cls._active._stop()
+        cls._captured_tags = set()
+
+    def tick(self, epoch: int) -> None:
+        if self._done or epoch != self.target_epoch:
+            return
+        if not self._started:
+            if self._ticks < self._skip:
+                self._ticks += 1
+                return
+            if (ProfileWindow._active is not None
+                    or len(ProfileWindow._captured_tags)
+                    >= ProfileWindow.MAX_CAPTURES):
+                self._done = True   # mid-capture elsewhere / budget spent
+                return
+            try:
+                jax.profiler.start_trace(self.dir)
+            except Exception:   # noqa: BLE001 — profiling must not kill the run
+                self._done = True
+                ProfileWindow._captured_tags.add(self.tag)
+                return
+            ProfileWindow._active = self
+            self._started = True
+            self._ticks = 0
+            return
+        self._ticks += 1
+        if self._ticks >= self.window_chunks:
+            self._stop()
+
+    def epoch_end(self, epoch: int) -> None:
+        if self._started and epoch == self.target_epoch:
+            self._stop()
+
+    def close(self) -> None:
+        self._stop()
+
+    def _stop(self) -> None:
+        if self._started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:   # noqa: BLE001
+                pass
+            self._started = False
+        if ProfileWindow._active is self:
+            ProfileWindow._active = None
+        if not self._done:
+            self._done = True
+            ProfileWindow._captured_tags.add(self.tag)
 
 
 def percentile(values: list[float], q: float) -> float:

@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import os
 
-from . import flightrec, heartbeat, registry, tracing
+from . import flightrec, heartbeat, registry, tracing, xla
+from .profiler import ProfileWindow
 
 DEFAULT_TRACE_NAME = "trace.json"
 
@@ -36,12 +37,17 @@ def _workdir(cfg) -> str:
 
 
 class ObsSession:
-    def __init__(self, cfg):
+    def __init__(self, cfg, logger=None):
         self.cfg = cfg
+        # Optional MetricsLogger: the XLA introspector / HBM monitor emit
+        # their {"kind": "xla_program"} / {"kind": "hbm_watermark"} JSONL
+        # records through it (gauges land in the registry either way).
+        self.logger = logger
         self.tracer: tracing.Tracer | None = None
         self.registry: registry.MetricsRegistry | None = None
         self.heartbeat: heartbeat.Heartbeat | None = None
         self.recorder: flightrec.FlightRecorder | None = None
+        self.xla: xla.XlaIntrospector | None = None
 
     def __enter__(self) -> "ObsSession":
         import jax
@@ -64,6 +70,15 @@ class ObsSession:
             fr_dir = cfg.obs.flightrec_dir or _workdir(cfg)
             self.recorder = flightrec.install(flightrec.FlightRecorder(
                 fr_dir, rank, capacity=cfg.obs.flightrec_capacity))
+        if cfg.obs.xla_introspect:
+            self.xla = xla.install(
+                xla.XlaIntrospector(logger=self.logger),
+                xla.HbmMonitor(logger=self.logger,
+                               jump_frac=cfg.obs.hbm_jump_frac))
+        # A session is a fresh run: clear the process-wide profile-window
+        # bookkeeping so this run's stages can capture again (tests enter
+        # many sessions per process).
+        ProfileWindow.reset()
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -81,6 +96,7 @@ class ObsSession:
                 self.registry.write_prometheus(self.registry.prom_path)
             except OSError:
                 pass   # a dying disk must not mask the run's own outcome
+        xla.uninstall()
         flightrec.uninstall()
         heartbeat.uninstall()
         registry.uninstall()

@@ -1,0 +1,367 @@
+"""Compiled-program introspection: XLA cost/memory analysis, MFU, HBM gauges.
+
+The PR-4 obs layer sees the PIPELINE (spans, dispatch histograms, heartbeats)
+but nothing inside a dispatch: no FLOPs, no HBM watermark, no utilization.
+This module extends it down into the XLA/compile layer:
+
+* ``XlaIntrospector`` — harvests, once per (program, geometry) cache key, the
+  compiled executable's ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (argument/output/temp bytes) plus the compile
+  wall-time, via the AOT path (``fn.lower(*args).compile()``). jax's
+  compilation cache (``pxla._cached_compilation``, a ``weakref_lru_cache``)
+  is shared between the AOT path and the normal dispatch path, so harvesting
+  BEFORE the first dispatch pays the backend compile exactly once — the
+  first real call then retraces in Python but hits the cached executable
+  (measured on the CPU lane: the AOT harvest absorbs the compile; the
+  follow-up dispatch pays only the retrace).
+* Model-FLOPs-utilization: each harvested program records flops-per-example;
+  the epoch driver reports its achieved examples/s (``note_throughput``) and
+  the gauge ``mfu:<program>`` (plus the run-level ``mfu``) is achieved
+  FLOPs/s over the device fleet's peak. Peak FLOPs/device resolves from (in
+  order) the ``DDT_PEAK_FLOPS_PER_DEVICE`` env override, a TPU device-kind
+  table, or a one-shot jitted-matmul calibration (the CPU lane's only honest
+  peak) — the source is recorded next to the number, never laundered.
+* ``HbmMonitor`` — polls ``device.memory_stats()`` at chunk boundaries into
+  ``hbm_bytes_in_use`` / ``hbm_peak_bytes`` gauges, with a flight-recorder +
+  JSONL record on peak jumps >= ``jump_frac`` so an OOM post-mortem has a
+  watermark trail. Backends whose ``memory_stats()`` is ``None`` (CPU)
+  disable themselves after the first poll — graceful degradation, never a
+  crash.
+
+Like the tracer/registry/heartbeat/flightrec, the module-level helpers
+(``harvest``/``note_throughput``/``poll_memory``) are no-ops until an
+introspector is installed; instrumented callers pay one ``is None`` check.
+Every harvest is wrapped in a never-raise envelope: a backend returning
+empty or partial analysis (or refusing to lower) degrades to a record with
+nulls — introspection must never take down a run it observes.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+from . import flightrec
+from . import registry as obs_registry
+
+__all__ = ["XlaIntrospector", "HbmMonitor", "device_peak_flops", "install",
+           "uninstall", "current", "harvest", "note_throughput",
+           "poll_memory"]
+
+#: Peak dense-compute FLOPs per JAX DEVICE by TPU device kind (bf16 — the
+#: compute dtype this repo trains in). v2/v3 expose one device per CORE,
+#: v4/v5 one per chip (megacore). Sources: published per-chip peaks
+#: (v2 45, v3 123, v4 275, v5e 197, v5p 459 TFLOPs), halved for per-core
+#: generations. Substring-matched against ``device.device_kind``.
+TPU_PEAK_FLOPS_PER_DEVICE = {
+    "v5p": 459e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v4": 275e12,
+    "v3": 61.5e12,
+    "v2": 22.5e12,
+}
+
+#: Matmul size for the calibration fallback (f32[N,N] @ f32[N,N]): big enough
+#: to saturate a CPU's vector units, small enough to run in milliseconds.
+_CALIBRATE_N = 512
+_CALIBRATE_REPEATS = 3
+
+
+def _best_effort_float(v) -> float | None:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if f == f else None
+
+
+def device_peak_flops() -> tuple[float | None, str]:
+    """Peak FLOPs per device and the provenance of the number:
+    ``("env" | "table:<kind>" | "calibrated" | "unknown")``.
+
+    Resolution order: the ``DDT_PEAK_FLOPS_PER_DEVICE`` env override (exact
+    hardware knowledge beats any heuristic), the TPU device-kind table, then
+    a one-shot jitted f32 matmul calibration — on backends with no published
+    peak (the CPU lane) the MFU denominator is the measured dense-matmul
+    rate, and the recorded source says so."""
+    env = os.environ.get("DDT_PEAK_FLOPS_PER_DEVICE")
+    if env:
+        val = _best_effort_float(env)
+        if val and val > 0:
+            return val, "env"
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in TPU_PEAK_FLOPS_PER_DEVICE.items():
+        if sub in kind:
+            return peak, f"table:{jax.devices()[0].device_kind}"
+    try:
+        return _calibrate_peak_flops(), "calibrated"
+    except Exception:   # noqa: BLE001 — no peak is better than a crash
+        return None, "unknown"
+
+
+def _calibrate_peak_flops() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    n = _CALIBRATE_N
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((n, n), jnp.float32)
+    f(a, a).block_until_ready()   # compile outside the timed region
+    best = float("inf")
+    for _ in range(_CALIBRATE_REPEATS):
+        t0 = time.perf_counter()
+        f(a, a).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n * n * n / best
+
+
+def _first_cost_dict(cost) -> dict:
+    """``Compiled.cost_analysis()`` is a list of per-partition dicts on this
+    jax (0.4.37), a bare dict on others, and None/[] on backends that cannot
+    analyze — normalize to one (possibly empty) dict."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return cost if isinstance(cost, dict) else {}
+
+
+class XlaIntrospector:
+    """Harvest + publish per-compiled-program cost/memory analyses.
+
+    ``logger`` (a MetricsLogger, or None) receives one ``{"kind":
+    "xla_program"}`` JSONL record per harvested (program, geometry);
+    gauges land in the installed metrics registry (``xla_flops:<p>``,
+    ``xla_bytes_accessed:<p>``, ``xla_compile_s:<p>``, ``xla_peak_bytes:<p>``,
+    ``xla_arith_intensity:<p>``, ``mfu:<p>``, ``mfu``) and flow into the
+    Prometheus textfile with the rest of the registry."""
+
+    def __init__(self, logger=None, enabled: bool = True):
+        self.logger = logger
+        self.enabled = enabled
+        self._seen: set[tuple[str, Any]] = set()
+        self.programs: dict[str, dict] = {}   # name -> last harvested record
+        self._peak: tuple[float | None, str] | None = None   # lazy
+
+    # ------------------------------------------------------------- harvest
+
+    def harvest(self, name: str, fn, args: tuple, kwargs: dict,
+                key: Any, examples: int | None = None) -> None:
+        """Introspect ``fn``'s compiled program for this geometry ONCE.
+
+        Called by the jitted factories' dispatch wrappers on every call with
+        a cheap geometry ``key`` (batch/chunk shapes); unseen keys trigger
+        the AOT lower+compile (absorbing the backend compile the first real
+        dispatch would otherwise pay — the compilation cache is shared) and
+        the analysis publish. Marked seen BEFORE the attempt, so a backend
+        that cannot analyze degrades once, not per-dispatch."""
+        if not self.enabled or (name, key) in self._seen:
+            return
+        self._seen.add((name, key))
+        try:
+            self._harvest(name, fn, args, kwargs, key, examples)
+        except Exception as exc:   # noqa: BLE001 — introspection never crashes a run
+            rec = {"program": name, "geometry": str(key), "compile_s": None,
+                   "flops": None, "bytes_accessed": None, "peak_bytes": None,
+                   "error": repr(exc)[:200]}
+            self.programs.setdefault(name, rec)
+            if self.logger is not None:
+                self.logger.log("xla_program", **rec)
+
+    def _harvest(self, name, fn, args, kwargs, key, examples) -> None:
+        t0 = time.perf_counter()
+        compiled = fn.lower(*args, **kwargs).compile()
+        compile_s = time.perf_counter() - t0
+        cost = {}
+        try:
+            cost = _first_cost_dict(compiled.cost_analysis())
+        except Exception:   # noqa: BLE001 — partial analysis is normal
+            pass
+        mem = None
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:   # noqa: BLE001
+            pass
+        flops = _best_effort_float(cost.get("flops"))
+        byts = _best_effort_float(cost.get("bytes accessed"))
+
+        def _mem(attr):
+            v = getattr(mem, attr, None) if mem is not None else None
+            return int(v) if isinstance(v, (int, float)) else None
+
+        # NOTE on units: for SPMD programs this jax reports PER-PARTITION
+        # numbers (flops = total / n_devices; memory sizes are the
+        # per-device allocations) — the records and gauges carry them as
+        # harvested, and note_throughput's MFU math accounts for it.
+        arg_b, out_b = _mem("argument_size_in_bytes"), _mem("output_size_in_bytes")
+        tmp_b, alias_b = _mem("temp_size_in_bytes"), _mem("alias_size_in_bytes")
+        known = [b for b in (arg_b, out_b, tmp_b) if b is not None]
+        # No explicit peak on this jax's CompiledMemoryStats: the live-set
+        # upper bound (args + outputs + temps, donation overlap excluded) is
+        # the documented ESTIMATE the gauge carries.
+        peak_b = (sum(known) - (alias_b or 0)) if known else None
+        rec: dict[str, Any] = {
+            "program": name, "geometry": str(key), "compile_s": round(compile_s, 4),
+            "flops": flops, "bytes_accessed": byts,
+            "argument_bytes": arg_b, "output_bytes": out_b,
+            "temp_bytes": tmp_b, "peak_bytes": peak_b,
+        }
+        if flops and byts:
+            rec["arith_intensity"] = round(flops / byts, 3)
+        if examples:
+            rec["examples"] = int(examples)
+            if flops:
+                rec["flops_per_example"] = flops / examples
+        self.programs[name] = rec
+        for g, v in (("flops", flops), ("bytes_accessed", byts),
+                     ("compile_s", compile_s), ("peak_bytes", peak_b),
+                     ("arith_intensity", rec.get("arith_intensity"))):
+            if v is not None:
+                obs_registry.set_gauge(f"xla_{g}:{name}", v)
+        if self.logger is not None:
+            self.logger.log("xla_program", **rec)
+
+    # ----------------------------------------------------------------- MFU
+
+    def peak_flops_per_device(self) -> tuple[float | None, str]:
+        if self._peak is None:
+            self._peak = device_peak_flops()
+            if self._peak[0] is not None:
+                obs_registry.set_gauge("xla_peak_flops_per_device",
+                                       self._peak[0])
+        return self._peak
+
+    def note_throughput(self, name: str, examples_per_s: float) -> float | None:
+        """Model-FLOPs-utilization for program ``name`` at the reported
+        steady-state throughput. Returns the MFU (also published as gauges
+        ``mfu:<name>`` and the run-level ``mfu``), or None when the program
+        was never analyzed or no peak is known.
+
+        Units (measured on this jax 0.4.37): a sharded program's
+        ``cost_analysis()['flops']`` is the PER-PARTITION program — total
+        flops / n_devices — while ``examples`` is the global count, so
+        ``flops_per_example`` is the per-DEVICE flops per global example.
+        Multiplying by the global examples/s therefore yields per-device
+        achieved FLOPs/s, and the denominator is the per-device peak —
+        NOT the fleet total, which would understate MFU by n_devices."""
+        if not self.enabled:
+            return None
+        rec = self.programs.get(name)
+        fpe = rec.get("flops_per_example") if rec else None
+        if not fpe or not examples_per_s or examples_per_s <= 0:
+            return None
+        peak, _source = self.peak_flops_per_device()
+        if not peak:
+            return None
+        mfu = (examples_per_s * fpe) / peak
+        obs_registry.set_gauge(f"mfu:{name}", mfu)
+        obs_registry.set_gauge("mfu", mfu)
+        return mfu
+
+    def summary(self) -> dict[str, dict]:
+        """Per-program harvested records (the ``run_summary`` xla block)."""
+        return {
+            name: {k: rec.get(k) for k in
+                   ("geometry", "flops", "bytes_accessed", "compile_s",
+                    "peak_bytes", "arith_intensity", "flops_per_example",
+                    "error") if rec.get(k) is not None}
+            for name, rec in self.programs.items()}
+
+
+class HbmMonitor:
+    """Device-memory watermarks from ``device.memory_stats()``.
+
+    ``poll()`` is called from chunk/epoch boundaries: gauges
+    ``hbm_bytes_in_use`` / ``hbm_peak_bytes`` track the max over local
+    devices, and a peak jump >= ``jump_frac`` (relative to the last recorded
+    watermark) lands a ``{"kind": "hbm_watermark"}`` JSONL record plus a
+    flight-recorder entry — the trail an OOM post-mortem replays. A backend
+    whose ``memory_stats()`` returns None (CPU) disables the monitor after
+    the first poll; a poll never raises."""
+
+    def __init__(self, logger=None, jump_frac: float = 0.10):
+        self.logger = logger
+        self.jump_frac = jump_frac
+        self._disabled = False
+        self._last_peak = 0.0
+
+    def poll(self) -> dict | None:
+        if self._disabled:
+            return None
+        try:
+            return self._poll()
+        except Exception:   # noqa: BLE001 — observation must not kill the run
+            self._disabled = True
+            return None
+
+    def _poll(self) -> dict | None:
+        import jax
+        in_use = peak = 0.0
+        device = None
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            used = _best_effort_float(stats.get("bytes_in_use")) or 0.0
+            pk = (_best_effort_float(stats.get("peak_bytes_in_use"))
+                  or used)
+            if pk >= peak:
+                device, in_use, peak = str(d), used, pk
+        if device is None:   # no backend exposes stats: stop polling
+            self._disabled = True
+            return None
+        obs_registry.set_gauge("hbm_bytes_in_use", in_use)
+        obs_registry.set_gauge("hbm_peak_bytes", peak)
+        jumped = (self._last_peak == 0.0
+                  or peak >= self._last_peak * (1.0 + self.jump_frac))
+        if jumped:
+            rec = {"device": device, "bytes_in_use": int(in_use),
+                   "peak_bytes": int(peak),
+                   "prev_peak_bytes": int(self._last_peak)}
+            flightrec.record("hbm_watermark", **rec)
+            if self.logger is not None:
+                self.logger.log("hbm_watermark", **rec)
+            self._last_peak = peak
+        return {"device": device, "bytes_in_use": in_use, "peak_bytes": peak}
+
+
+# --------------------------------------------------------- module-level slot
+
+_INTROSPECTOR: XlaIntrospector | None = None
+_HBM: HbmMonitor | None = None
+
+
+def install(introspector: XlaIntrospector,
+            hbm: HbmMonitor | None = None) -> XlaIntrospector:
+    global _INTROSPECTOR, _HBM
+    _INTROSPECTOR = introspector
+    _HBM = hbm
+    return introspector
+
+
+def uninstall() -> None:
+    global _INTROSPECTOR, _HBM
+    _INTROSPECTOR = None
+    _HBM = None
+
+
+def current() -> XlaIntrospector | None:
+    return _INTROSPECTOR
+
+
+def harvest(name: str, fn, args: tuple, kwargs: dict, key: Any,
+            examples: int | None = None) -> None:
+    if _INTROSPECTOR is not None:
+        _INTROSPECTOR.harvest(name, fn, args, kwargs, key, examples)
+
+
+def note_throughput(name: str, examples_per_s: float) -> float | None:
+    if _INTROSPECTOR is not None:
+        return _INTROSPECTOR.note_throughput(name, examples_per_s)
+    return None
+
+
+def poll_memory() -> dict | None:
+    if _HBM is not None:
+        return _HBM.poll()
+    return None

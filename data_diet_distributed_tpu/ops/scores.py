@@ -362,6 +362,7 @@ def make_score_chunk(model, method: str, mesh: Mesh | None = None,
     compile-identity reasons as the train chunk (train/steps.py docstring).
     ``use_pallas`` None resolves like the step factories."""
     from ..obs import registry as obs_registry
+    from ..obs import xla as obs_xla
 
     local = make_local_scores(model, resolve_score_method(method, eval_mode),
                               chunk=chunk, eval_mode=eval_mode,
@@ -391,10 +392,17 @@ def make_score_chunk(model, method: str, mesh: Mesh | None = None,
     jitted = jax.jit(score_chunk)
 
     @functools.wraps(jitted)
-    def dispatch(*args, **kwargs):
+    def dispatch(variables, images, labels, mask, **kwargs):
         # Host-side dispatch counter (train/steps._counted's pattern): the
         # chunked engine's whole point is fewer dispatches — count them.
         obs_registry.inc("dispatches_score_chunk")
-        return jitted(*args, **kwargs)
+        if obs_xla.current() is not None:
+            # Once-per-geometry compiled-program harvest (cost/memory
+            # analysis, compile wall) — [K, B] blocks score K*B examples.
+            obs_xla.harvest("score_chunk", jitted,
+                            (variables, images, labels, mask), kwargs,
+                            images.shape[:2],
+                            images.shape[0] * images.shape[1])
+        return jitted(variables, images, labels, mask, **kwargs)
 
     return dispatch

@@ -37,6 +37,8 @@ from ..models import create_model_from_cfg
 from ..obs import MetricsLogger, flightrec, tracing
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
+from ..obs import xla as obs_xla
+from ..obs.profiler import ProfileWindow
 from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
 from ..pruning import select_indices
@@ -331,6 +333,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
 
     result = FitResult(state=state)
     t_start = time.perf_counter()
+    profile = None
     try:
         augment = ((cfg.data.crop_pad, cfg.data.flip, cfg.train.seed)
                    if cfg.data.augment else None)
@@ -385,6 +388,14 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                     if wd_timeout else None)
         preempt = PreemptionHandler(enabled=cfg.resilience.preemption)
         sentinel = LossSentinel(enabled=cfg.resilience.nan_check)
+        # Automatic steady-state profiler window (obs.profile_dir): a bounded
+        # jax.profiler capture of obs.profile_window_chunks dispatches from
+        # this stage's first post-compile epoch — one capture per stage tag.
+        if cfg.obs.profile_dir and jax.process_index() == 0:
+            profile = ProfileWindow(
+                cfg.obs.profile_dir, tag, start_epoch=start_epoch,
+                num_epochs=cfg.train.num_epochs,
+                window_chunks=cfg.obs.profile_window_chunks)
         with preempt, (watchdog or contextlib.nullcontext()), \
                 tracing.span("fit", cat="fit", tag=tag,
                              epochs=cfg.train.num_epochs):
@@ -394,8 +405,10 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         test_resident, steps_per_epoch, epoch_hook,
                         watchdog=watchdog, preempt=preempt, sentinel=sentinel,
                         consensus=consensus, chunk_steps=chunk_steps,
-                        augment=augment)
+                        augment=augment, profile=profile)
     finally:
+        if profile is not None:
+            profile.close()   # a mid-capture exception must stop the profiler
         if ckpt is not None:
             ckpt.close()
     result.wall_s = time.perf_counter() - t_start
@@ -480,7 +493,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 saved_steps=None, train_resident=None, test_resident=None,
                 steps_per_epoch=None, epoch_hook=None, watchdog=None,
                 preempt=None, sentinel=None, consensus=None, chunk_steps=1,
-                augment=None):
+                augment=None, profile=None):
     chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding)
                 if chunk_steps > 1 else None)
     # Host-side optimizer-step accounting for log events (fetching state.step
@@ -513,6 +526,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 unit = epoch * steps_per_epoch + done
                 obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
                 inject.fire("step", epoch=epoch, step=unit)
+                if profile is not None:
+                    profile.tick(epoch)
                 # The span measures the host-side DISPATCH (permutation
                 # upload + enqueue; blocks only when the device queue is
                 # full) — per-chunk dispatch timing in the trace is the
@@ -523,6 +538,9 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                     state, metrics = _dispatch_chunk(chunk_fn, state,
                                                      train_resident, idx, mask)
                 step_metrics.append(metrics)
+                # HBM watermark poll at the chunk boundary (no-op on
+                # backends without memory_stats, e.g. CPU).
+                obs_xla.poll_memory()
                 prev_done, done = done, done + idx.shape[0]
                 if (done // cfg.train.log_every_steps
                         > prev_done // cfg.train.log_every_steps):
@@ -558,6 +576,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                     # will never join — PeerPoisoned, not an unbounded hang.
                     consensus.check_peers(unit)
                 inject.fire("step", epoch=epoch, step=unit)
+                if profile is not None:
+                    profile.tick(epoch)
                 t_disp = time.perf_counter()
                 state, metrics = train_step(state, batch)
                 obs_registry.observe("step_dispatch_s",
@@ -652,6 +672,16 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         obs_registry.inc("steps", steps_per_epoch)
         obs_registry.observe("epoch_s", epoch_s)
         obs_registry.set_gauge("examples_per_s", record["examples_per_s"])
+        if epoch > start_epoch:
+            # MFU from the harvested program's flops/example at this epoch's
+            # steady-state throughput (epoch 0 folds compile into the wall,
+            # so it would report a compile-diluted utilization).
+            obs_xla.note_throughput(
+                "train_chunk" if chunk_steps > 1 else "train_step",
+                record["examples_per_s"])
+        obs_xla.poll_memory()   # per-epoch watermark for the per-step path
+        if profile is not None:
+            profile.epoch_end(epoch)
         tracing.complete("epoch", epoch_t0, cat="epoch", epoch=epoch, tag=tag)
         obs_registry.maybe_snapshot(logger, cfg.obs.snapshot_every_s)
         save_now = ckpt is not None and (
@@ -1015,6 +1045,14 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                                           stages=stages)
     obs_registry.observe("score_s", timings["score_s"])
     obs_registry.observe("score_pretrain_s", timings["pretrain_s"])
+    if timings.get("passes") and timings["score_s"] > 0:
+        # Scoring-side MFU: the chunked score engine's harvested
+        # flops/example at the measured scoring rate (silently None when the
+        # pass ran per-batch — only the chunk program is introspected).
+        obs_xla.note_throughput(
+            "score_chunk",
+            len(train_ds) * timings["passes"] / timings["score_s"])
+    obs_xla.poll_memory()
     return scores, timings
 
 

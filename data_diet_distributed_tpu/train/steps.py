@@ -44,21 +44,40 @@ import jax.numpy as jnp
 
 from ..data.pipeline import gather_resident_batch
 from ..obs import registry as obs_registry
+from ..obs import xla as obs_xla
 from ..ops.scores import cross_entropy
 from .state import TrainState
 
 
-def _counted(fn, name: str):
-    """Host-side dispatch counter around a jitted step: one registry counter
-    increment per CALL (outside the traced program — a Python side effect
-    inside it would run once at trace time). No-op-cheap when no registry is
-    installed; never touches the computation, so the chunked engine's
-    bit-exactness contract is untouched."""
+def _batch_key(state, batch):
+    """(geometry key, examples-per-dispatch) for the per-dispatch steps."""
+    shape = batch["image"].shape
+    return shape, shape[0]
+
+
+def _chunk_key(state, images, labels, indices, idx, mask):
+    """(geometry key, examples) for the chunked programs: one compilation per
+    distinct chunk length K (idx is [K, B]) and resident geometry."""
+    return (idx.shape, images.shape), idx.shape[0] * idx.shape[1]
+
+
+def _counted(fn, name: str, keyfn=None):
+    """Host-side dispatch counter + XLA introspection hook around a jitted
+    step: one registry counter increment per CALL (outside the traced
+    program — a Python side effect inside it would run once at trace time),
+    and — when an ``obs/xla.XlaIntrospector`` is installed — a once-per-
+    geometry harvest of the compiled program's cost/memory analysis and
+    compile wall-time (``keyfn(*args)`` -> (cheap geometry key, examples)).
+    No-op-cheap when nothing is installed; never touches the computation, so
+    the chunked engine's bit-exactness contract is untouched."""
     counter = f"dispatches_{name}"
 
     @functools.wraps(fn)
     def dispatch(*args, **kwargs):
         obs_registry.inc(counter)
+        if keyfn is not None and obs_xla.current() is not None:
+            key, examples = keyfn(*args)
+            obs_xla.harvest(name, fn, args, kwargs, key, examples)
         return fn(*args, **kwargs)
 
     return dispatch
@@ -110,7 +129,8 @@ def make_train_step(model, augment: tuple[int, bool, int] | None = None):
     def train_step(state: TrainState, batch):
         return _train_step_math(model, augment, state, batch)
 
-    return _counted(jax.jit(train_step, donate_argnums=(0,)), "train_step")
+    return _counted(jax.jit(train_step, donate_argnums=(0,)), "train_step",
+                    keyfn=_batch_key)
 
 
 @functools.cache
@@ -159,7 +179,8 @@ def make_train_chunk(model, augment: tuple[int, bool, int] | None = None,
         # the identical step program repeated, so chunked == per-step bitwise.
         return jax.lax.scan(body, state, (idx, mask), unroll=True)
 
-    return _counted(jax.jit(train_chunk, donate_argnums=(0,)), "train_chunk")
+    return _counted(jax.jit(train_chunk, donate_argnums=(0,)), "train_chunk",
+                    keyfn=_chunk_key)
 
 
 @functools.cache
@@ -180,7 +201,7 @@ def make_eval_chunk(model, out_sharding=None):
         _, out = jax.lax.scan(body, 0, (idx, mask), unroll=True)
         return out
 
-    return _counted(jax.jit(eval_chunk), "eval_chunk")
+    return _counted(jax.jit(eval_chunk), "eval_chunk", keyfn=_chunk_key)
 
 
 @functools.cache
@@ -188,4 +209,4 @@ def make_eval_step(model):
     def eval_step(state: TrainState, batch):
         return _eval_step_math(model, state, batch)
 
-    return _counted(jax.jit(eval_step), "eval_step")
+    return _counted(jax.jit(eval_step), "eval_step", keyfn=_batch_key)

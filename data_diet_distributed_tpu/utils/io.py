@@ -8,6 +8,7 @@ destination path only ever holds a complete file or the previous one.
 
 from __future__ import annotations
 
+import json
 import os
 
 import numpy as np
@@ -19,3 +20,34 @@ def atomic_savez(path: str, **arrays) -> None:
     tmp = f"{path}.tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)
+
+
+def atomic_append_jsonl(path: str, record: dict) -> None:
+    """Append one JSON record to an append-only ledger atomically.
+
+    The record is serialized to a single line FIRST, then written with one
+    ``write`` on an O_APPEND descriptor — POSIX guarantees appends up to
+    PIPE_BUF land contiguously, so concurrent writers (a bench run racing a
+    CLI run) interleave whole records, never torn ones. NaN/inf are nulled
+    at encode time (bare NaN is not valid JSON — the ledger's readers parse
+    strictly). Parent directories are created on demand."""
+    line = json.dumps(_finite(record)) + "\n"
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode())
+    finally:
+        os.close(fd)
+
+
+def _finite(v):
+    """Recursively replace non-finite floats with None (JSON has no NaN)."""
+    if isinstance(v, dict):
+        return {k: _finite(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_finite(x) for x in v]
+    if isinstance(v, float) and (v != v or v in (float("inf"), float("-inf"))):
+        return None
+    return v

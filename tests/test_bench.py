@@ -39,7 +39,7 @@ def test_bench_emits_error_json_when_backend_unavailable():
     measured zero."""
     env = dict(os.environ, JAX_PLATFORMS="bogus", PALLAS_AXON_POOL_IPS="")
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py"), "--size", "64",
+        [sys.executable, str(REPO / "bench.py"), "--no-ledger", "--size", "64",
          "--batch", "32", "--arch", "tiny_cnn",
          "--probe-attempts", "1", "--probe-timeout", "60"],
         capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
@@ -73,7 +73,8 @@ def test_bench_preempted_run_classified_not_zeroed(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_score", preempted_run)
     monkeypatch.setattr(
         sys, "argv",
-        ["bench.py", "--no-probe", "--size", "64", "--arch", "tiny_cnn"])
+        ["bench.py", "--no-probe", "--no-ledger", "--size", "64",
+         "--arch", "tiny_cnn"])
     with pytest.raises(SystemExit) as exc_info:
         bench.main()
     assert exc_info.value.code == 75
@@ -134,7 +135,7 @@ def test_bench_bounded_json_under_injected_probe_hang():
                DDT_PROBE_SNIPPET="import time; time.sleep(60)")
     t0 = time.monotonic()
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py"), "--size", "64",
+        [sys.executable, str(REPO / "bench.py"), "--no-ledger", "--size", "64",
          "--batch", "32", "--arch", "tiny_cnn",
          "--probe-attempts", "2", "--probe-timeout", "2",
          "--probe-backoff", "0.1", "--fresh-retries", "1"],
@@ -178,7 +179,7 @@ def test_fresh_process_retry_relays_child_json(monkeypatch, capsys):
                          "resets": 2, "wall_s": 1.0})
     monkeypatch.setattr(
         sys, "argv",
-        ["bench.py", "--size", "64", "--arch", "tiny_cnn",
+        ["bench.py", "--no-ledger", "--size", "64", "--arch", "tiny_cnn",
          "--fresh-retries", "2"])
     with pytest.raises(SystemExit) as exc_info:
         bench.main()
@@ -206,7 +207,7 @@ def test_bench_northstar_smoke():
     wall seconds with a workload-scaled vs_baseline."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py"), "--task", "northstar",
+        [sys.executable, str(REPO / "bench.py"), "--no-ledger", "--task", "northstar",
          "--size", "128", "--seeds", "2", "--batch", "64",
          "--arch", "tiny_cnn", "--chunk", "8", "--no-probe"],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)

@@ -1,0 +1,246 @@
+"""Perf-regression sentry (tools/perf_sentry.py): wedge-shaped records are
+capture-errors that never poison the baseline, regressions past the
+threshold exit nonzero, and the BENCH_r01-r05 backfill classifies the blind
+rounds exactly as the round notes recorded them. The exit-code contract
+(0 ok / 1 regression / 2 newest-capture-error) is pinned here."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+import perf_sentry as ps  # noqa: E402
+
+from data_diet_distributed_tpu.utils.io import atomic_append_jsonl  # noqa: E402
+
+
+def _rec(value, *, metric="m", unit="examples/sec/chip", **extra):
+    return {"kind": "perf_history", "ts": 0.0, "source": "test",
+            "metric": metric, "value": value, "unit": unit, **extra}
+
+
+def _ledger(tmp_path, records, name="ledger.jsonl"):
+    path = tmp_path / name
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+# -------------------------------------------------------- classification
+
+
+def test_classify_wedge_shapes():
+    assert ps.classify_record(_rec(100.0)) == ps.CLEAN
+    assert ps.classify_record(_rec(0.0)) == ps.CAPTURE_ERROR
+    assert ps.classify_record(_rec(-5.0)) == ps.CAPTURE_ERROR
+    assert ps.classify_record(_rec(None)) == ps.CAPTURE_ERROR
+    assert ps.classify_record(_rec(100.0, error="probe hung")) \
+        == ps.CAPTURE_ERROR
+    assert ps.classify_record(_rec(100.0, exit_class="retriable")) \
+        == ps.CAPTURE_ERROR
+    assert ps.classify_record(_rec(100.0, exit_class="ok")) == ps.CLEAN
+    assert ps.classify_record(_rec(True)) == ps.CAPTURE_ERROR  # not a number
+
+
+# ------------------------------------------------------- verdicts + exits
+
+
+def test_clean_improvement_exits_zero(tmp_path):
+    path = _ledger(tmp_path, [_rec(100.0), _rec(102.0), _rec(150.0)])
+    assert ps.main([path]) == ps.EXIT_OK
+    rep = ps.check_ledger(ps.load_ledger(path))
+    assert rep["groups"][0]["status"] == ps.IMPROVEMENT
+
+
+def test_regression_exits_one(tmp_path, capsys):
+    path = _ledger(tmp_path, [_rec(100.0), _rec(101.0), _rec(99.0),
+                              _rec(80.0)])
+    assert ps.main([path]) == ps.EXIT_REGRESSION
+    assert "regression" in capsys.readouterr().out
+    rep = ps.check_ledger(ps.load_ledger(path))
+    g = rep["groups"][0]
+    assert g["status"] == ps.REGRESSION
+    assert g["delta_frac"] == pytest.approx(-0.2)
+    assert g["baseline_median"] == 100.0
+
+
+def test_threshold_is_configurable(tmp_path):
+    path = _ledger(tmp_path, [_rec(100.0), _rec(100.0), _rec(85.0)])
+    assert ps.main([path]) == ps.EXIT_REGRESSION          # default 10%
+    assert ps.main([path, "--threshold", "0.2"]) == ps.EXIT_OK
+
+
+def test_wedge_never_poisons_baseline(tmp_path):
+    """Two 0.0 wedge records between clean 100s: the baseline median stays
+    100, so a following 95 is OK — NOT a recovery from zero, and the zeros
+    are reported as capture-errors, not regressions."""
+    path = _ledger(tmp_path, [
+        _rec(100.0), _rec(0.0, error="probe hung"),
+        _rec(0.0, error="probe hung"), _rec(101.0), _rec(95.0)])
+    rep = ps.check_ledger(ps.load_ledger(path))
+    g = rep["groups"][0]
+    assert g["status"] == ps.OK
+    assert g["baseline_median"] == pytest.approx(100.5)
+    assert rep["capture_errors"] == 2
+    assert rep["exit_code"] == ps.EXIT_OK
+
+
+def test_newest_wedge_exits_two(tmp_path):
+    path = _ledger(tmp_path, [_rec(100.0), _rec(0.0, error="wedge")])
+    assert ps.main([path]) == ps.EXIT_CAPTURE_ERROR
+    rep = ps.check_ledger(ps.load_ledger(path))
+    assert rep["groups"][0]["status"] == ps.NEWEST_CAPTURE_ERROR
+
+
+def test_stale_blind_group_does_not_pin_exit_two(tmp_path):
+    """A group whose LAST record (long ago) was a wedge must not hold the
+    sentry at exit 2 forever once newer runs of other groups are healthy —
+    exit 2 keys off the newest appended record overall."""
+    path = _ledger(tmp_path, [
+        _rec(0.0, metric="old_metric", error="wedge"),
+        _rec(100.0, metric="new_metric"), _rec(101.0, metric="new_metric")])
+    assert ps.main([path]) == ps.EXIT_OK
+
+
+def test_seconds_unit_is_lower_better(tmp_path):
+    path = _ledger(tmp_path, [_rec(60.0, unit="seconds"),
+                              _rec(61.0, unit="seconds"),
+                              _rec(80.0, unit="seconds")])
+    assert ps.main([path]) == ps.EXIT_REGRESSION
+    path2 = _ledger(tmp_path, [_rec(60.0, unit="seconds"),
+                               _rec(40.0, unit="seconds")], name="l2.jsonl")
+    rep = ps.check_ledger(ps.load_ledger(path2))
+    assert rep["groups"][0]["status"] == ps.IMPROVEMENT
+
+
+def test_groups_compare_within_geometry_only(tmp_path):
+    """A big-geometry run must never baseline a small-geometry one: the
+    (metric, backend, geometry) key separates them."""
+    path = _ledger(tmp_path, [
+        _rec(1000.0, geometry={"size": 50000}, backend="tpu"),
+        _rec(100.0, geometry={"size": 256}, backend="cpu")])
+    rep = ps.check_ledger(ps.load_ledger(path))
+    assert len(rep["groups"]) == 2
+    assert all(g["status"] == ps.NO_BASELINE for g in rep["groups"])
+    assert rep["exit_code"] == ps.EXIT_OK
+
+
+def test_window_bounds_the_baseline(tmp_path):
+    """--window 3: the median forgets records older than the trailing
+    window, so a slow drift is judged against the RECENT trail."""
+    recs = [_rec(v) for v in (50.0, 52.0, 100.0, 101.0, 102.0, 90.0)]
+    rep = ps.check_ledger(ps.load_ledger(_ledger(tmp_path, recs)), window=3)
+    g = rep["groups"][0]
+    assert g["baseline_median"] == 101.0
+    assert g["status"] == ps.REGRESSION
+
+
+# --------------------------------------------- BENCH backfill (acceptance)
+
+
+BENCH_ARTIFACTS = sorted(REPO.glob("BENCH_r0[1-5].json"))
+
+
+def test_backfill_classifies_blind_rounds(tmp_path):
+    """The repo's own history: r01/r02 clean, r03 unparseable, r04/r05 the
+    device-claim wedge — backfilled, the sentry reports capture-errors (exit
+    2: the newest round IS blind), never a regression."""
+    assert len(BENCH_ARTIFACTS) == 5
+    ledger = str(tmp_path / "ledger.jsonl")
+    argv = ["--import-bench"] + [str(p) for p in BENCH_ARTIFACTS] + \
+        ["--ledger", ledger]
+    assert ps.main(argv) == 0
+    records = ps.load_ledger(ledger)
+    assert [r["round"] for r in records] == [1, 2, 3, 4, 5]
+    by_round = {r["round"]: ps.classify_record(r) for r in records}
+    assert by_round[1] == ps.CLEAN and by_round[2] == ps.CLEAN
+    assert by_round[3] == ps.CAPTURE_ERROR
+    assert by_round[4] == ps.CAPTURE_ERROR
+    assert by_round[5] == ps.CAPTURE_ERROR
+    assert ps.main([ledger]) == ps.EXIT_CAPTURE_ERROR
+    rep = ps.check_ledger(records)
+    assert not any(g["status"] == ps.REGRESSION for g in rep["groups"])
+
+
+def test_backfill_plus_injected_regression_flags_nonzero(tmp_path):
+    """Acceptance: over the backfilled history, an injected -20% throughput
+    record (clean capture, genuinely slower) exits nonzero as a REGRESSION —
+    judged against the r01/r02 trail, with the wedge rounds excluded."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    ps.backfill([str(p) for p in BENCH_ARTIFACTS], ledger)
+    clean = [r for r in ps.load_ledger(ledger)
+             if ps.classify_record(r) == ps.CLEAN]
+    median = sorted(r["value"] for r in clean)[len(clean) // 2]
+    atomic_append_jsonl(ledger, _rec(
+        round(median * 0.8, 1),
+        metric="grand_scoring_examples_per_sec_per_chip"))
+    assert ps.main([ledger]) == ps.EXIT_REGRESSION
+    # A healthy follow-up at the old rate goes back to exit 0... and the
+    # regression record (clean, just slow) joins the trailing median.
+    atomic_append_jsonl(ledger, _rec(
+        median, metric="grand_scoring_examples_per_sec_per_chip"))
+    assert ps.main([ledger]) == ps.EXIT_OK
+
+
+def test_committed_ledger_matches_backfill(tmp_path):
+    """The committed artifacts/perf_history.jsonl starts with exactly the
+    r01-r05 backfill this PR ran (plus whatever later runs appended)."""
+    committed = ps.load_ledger(str(REPO / "artifacts" / "perf_history.jsonl"))
+    backfilled = [r for r in committed if r.get("source") == "bench_backfill"]
+    assert [r["round"] for r in backfilled[:5]] == [1, 2, 3, 4, 5]
+
+
+# -------------------------------------------------------- ledger appends
+
+
+def test_atomic_append_jsonl_whole_records(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    threads = [threading.Thread(
+        target=lambda i=i: [atomic_append_jsonl(path, {"w": i, "n": j})
+                            for j in range(20)]) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = [json.loads(l) for l in open(path)]   # every line parses whole
+    assert len(lines) == 80
+
+
+def test_atomic_append_jsonl_nulls_nan(tmp_path):
+    path = str(tmp_path / "sub" / "ledger.jsonl")   # parent dir auto-created
+    atomic_append_jsonl(path, {"v": float("nan"),
+                               "nested": {"x": float("inf")}, "ok": 1.5})
+    rec = json.loads(open(path).read())
+    assert rec["v"] is None and rec["nested"]["x"] is None and rec["ok"] == 1.5
+
+
+def test_bench_appends_ledger_record(tmp_path):
+    """bench.py --ledger: the emitted line lands in the ledger as a
+    schema-valid perf_history record the sentry accepts as clean."""
+    import os
+    import subprocess
+    ledger = str(tmp_path / "ledger.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--no-probe",
+         "--size", "128", "--batch", "64", "--arch", "tiny_cnn",
+         "--method", "el2n", "--repeats", "1", "--ledger", ledger],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    records = ps.load_ledger(ledger)
+    assert len(records) == 1
+    rec = records[0]
+    assert ps.classify_record(rec) == ps.CLEAN
+    assert rec["source"] == "bench" and rec["backend"] == "cpu"
+    assert rec["geometry"]["arch"] == "tiny_cnn"
+    assert rec["value"] > 0
+    sys.path.insert(0, str(REPO / "tools"))
+    import validate_metrics as vm
+    assert vm.validate_file(ledger) == []

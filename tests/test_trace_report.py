@@ -91,7 +91,8 @@ def test_cli_run_trace_report_end_to_end(tmp_path, mesh8):
     proc = subprocess.run(
         [sys.executable, str(REPO / "tools" / "trace_report.py"),
          str(tmp_path / "trace.json"),
-         "--heartbeats", str(tmp_path / "ckpt_heartbeats"), "--json"],
+         "--heartbeats", str(tmp_path / "ckpt_heartbeats"),
+         "--metrics", str(tmp_path / "metrics.jsonl"), "--json"],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-500:]
     report = json.loads(proc.stdout)
@@ -99,6 +100,8 @@ def test_cli_run_trace_report_end_to_end(tmp_path, mesh8):
     assert report["stages"]["retrain:final"]["total_s"] > 0
     assert report["epochs"], "per-epoch breakdown missing"
     assert report["heartbeats"]["0"]["stage"] == "final"
+    # The XLA section, sourced from the run's own introspection records.
+    assert report["xla"]["programs"]["train_chunk"]["flops"] > 0
 
     # Terminal event + stream validity (the validator is its own tool).
     lines = [l for l in open(tmp_path / "metrics.jsonl") if l.strip()]
@@ -136,3 +139,52 @@ def test_trace_report_empty_trace_errors(tmp_path):
         [sys.executable, str(REPO / "tools" / "trace_report.py"), str(empty)],
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 1
+
+def test_compile_vs_steady_split(tr):
+    """The first epoch of each fit tag carries the compiles; the report
+    splits it from the steady-state mean per stage."""
+    events = [
+        _span("epoch", "epoch", 0, 3_000_000, tag="final", epoch=0),
+        _span("epoch", "epoch", 3_000_000, 1_000_000, tag="final", epoch=1),
+        _span("epoch", "epoch", 4_000_000, 1_000_000, tag="final", epoch=2),
+        _span("epoch", "epoch", 5_000_000, 2_000_000, tag="dense", epoch=0),
+    ]
+    rep = tr.summarize(events)
+    split = rep["compile_split"]["final"]
+    assert split["compile_epoch_s"] == 3.0
+    assert split["steady_epoch_mean_s"] == 1.0
+    assert split["compile_overhead_s"] == 2.0
+    assert split["ratio"] == 3.0
+    # A single-epoch tag has no steady state to split against.
+    assert "dense" not in rep["compile_split"]
+    text = tr.render(rep)
+    assert "compile vs steady-state" in text
+
+
+def test_xla_section_from_metrics_stream(tr, tmp_path):
+    """--metrics sources the XLA block from xla_program records (and the
+    run_summary's harvest) plus the registry's MFU/HBM gauges."""
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "ts": 1.0, "kind": "xla_program", "program": "train_chunk",
+            "geometry": "((4, 64), ...)", "flops": 3.6e7, "compile_s": 0.52,
+            "bytes_accessed": 1.5e7, "peak_bytes": 2286104,
+            "arith_intensity": 2.36}) + "\n")
+        fh.write(json.dumps({
+            "ts": 2.0, "kind": "metrics", "counters": {},
+            "gauges": {"mfu": 0.41, "mfu:train_chunk": 0.41,
+                       "hbm_peak_bytes": 123456.0, "examples_per_s": 9.9},
+            "histograms": {}}) + "\n")
+    section = tr.xla_section(str(path))
+    assert section["programs"]["train_chunk"]["flops"] == 3.6e7
+    assert section["gauges"]["mfu"] == 0.41
+    assert section["gauges"]["hbm_peak_bytes"] == 123456.0
+    assert "examples_per_s" not in section["gauges"]
+    rep = tr.summarize([_span("x", "stage", 0.0, 1000.0)])
+    rep["xla"] = section
+    text = tr.render(rep)
+    assert "XLA compiled programs" in text and "train_chunk" in text
+    # Missing file degrades to an empty section, not a crash.
+    empty = tr.xla_section(str(tmp_path / "missing.jsonl"))
+    assert empty == {"programs": {}, "gauges": {}}

@@ -1,0 +1,400 @@
+"""Device-level performance observability (obs/xla.py): compiled-program
+cost/memory introspection across the four jitted factories, MFU derivation,
+HBM watermark polling, and the obs.profile_dir steady-state capture window —
+including the graceful-degradation contract (a backend returning empty or
+partial analysis must no-op, never crash a run)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger, MetricsRegistry
+from data_diet_distributed_tpu.obs import registry as obs_registry
+from data_diet_distributed_tpu.obs import xla as obs_xla
+from data_diet_distributed_tpu.obs.profiler import ProfileWindow
+from data_diet_distributed_tpu.train import loop as loop_mod
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import validate_metrics as vm  # noqa: E402
+
+
+@pytest.fixture()
+def installed(tmp_path):
+    """Registry + introspector (with a JSONL logger) installed for the test,
+    uninstalled after — the ObsSession wiring, without the session."""
+    logger = MetricsLogger(str(tmp_path / "metrics.jsonl"), echo=False)
+    reg = obs_registry.install(MetricsRegistry())
+    intro = obs_xla.install(obs_xla.XlaIntrospector(logger=logger),
+                            obs_xla.HbmMonitor(logger=logger))
+    yield reg, intro, tmp_path / "metrics.jsonl"
+    logger.close()
+    obs_xla.uninstall()
+    obs_registry.uninstall()
+
+
+def _cfg(tmp_path, **over):
+    overrides = [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=2",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+    ] + [f"{k}={v}" for k, v in over.items()]
+    return load_config(None, overrides)
+
+
+def _gauges():
+    return obs_registry.current().snapshot()["gauges"]
+
+
+# ------------------------------------------------- four-factory coverage
+
+
+def test_chunked_fit_harvests_train_and_eval_chunk(installed, tmp_path,
+                                                   mesh8, tiny_ds):
+    reg, intro, metrics_path = installed
+    train_ds, test_ds = tiny_ds
+    cfg = _cfg(tmp_path)
+    loop_mod.fit(cfg, train_ds, test_ds, mesh=mesh8)
+    g = _gauges()
+    for prog in ("train_chunk", "eval_chunk"):
+        assert g[f"xla_flops:{prog}"] > 0
+        assert g[f"xla_bytes_accessed:{prog}"] > 0
+        assert g[f"xla_compile_s:{prog}"] > 0
+        assert g[f"xla_peak_bytes:{prog}"] > 0
+        assert g[f"xla_arith_intensity:{prog}"] > 0
+    # MFU derived at the steady epoch from the harvested flops/example.
+    assert 0 < g["mfu:train_chunk"] < 1.0
+    assert g["mfu"] == g["mfu:train_chunk"]
+    # The JSONL carries schema-valid xla_program records for both programs.
+    recs = [json.loads(l) for l in open(metrics_path)]
+    progs = {r["program"] for r in recs if r["kind"] == "xla_program"}
+    assert {"train_chunk", "eval_chunk"} <= progs
+    assert vm.validate_lines(open(metrics_path)) == []
+
+
+def test_per_step_fit_harvests_train_and_eval_step(installed, tmp_path,
+                                                   mesh8, tiny_ds):
+    reg, intro, _ = installed
+    train_ds, test_ds = tiny_ds
+    cfg = _cfg(tmp_path, **{"train.chunk_steps": 0, "train.num_epochs": 1})
+    loop_mod.fit(cfg, train_ds, test_ds, mesh=mesh8)
+    g = _gauges()
+    assert g["xla_flops:train_step"] > 0 and g["xla_flops:eval_step"] > 0
+    assert g["xla_compile_s:train_step"] > 0
+    # A per-dispatch train step reads/writes the params every call; the
+    # chunked program amortizes — both must report a positive intensity.
+    assert g["xla_arith_intensity:train_step"] > 0
+
+
+def test_score_chunk_harvested(installed, tmp_path, mesh8, tiny_ds):
+    reg, intro, metrics_path = installed
+    train_ds, _ = tiny_ds
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder
+    from data_diet_distributed_tpu.models import create_model_from_cfg
+    from data_diet_distributed_tpu.ops.scoring import score_dataset
+    cfg = _cfg(tmp_path)
+    import jax
+    model = create_model_from_cfg(cfg)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0), np.zeros((1, 32, 32, 3), np.float32), train=False)
+    scores = score_dataset(model, [variables], train_ds, method="el2n",
+                           batch_size=64, sharder=BatchSharder.flat(mesh8),
+                           device_resident=True, chunk_steps=4)
+    assert scores.shape == (len(train_ds),)
+    g = _gauges()
+    assert g["xla_flops:score_chunk"] > 0
+    assert g["xla_compile_s:score_chunk"] > 0
+    rec = intro.programs["score_chunk"]
+    assert rec["examples"] == 4 * 64 and rec["flops_per_example"] > 0
+
+
+def test_no_introspector_is_a_noop(tmp_path, mesh8, tiny_ds):
+    """The factories' harvest hook costs one is-None check when nothing is
+    installed — no gauges, no records, no files (the PR-4 contract)."""
+    train_ds, _ = tiny_ds
+    assert obs_xla.current() is None
+    loop_mod.fit(_cfg(tmp_path, **{"train.num_epochs": 1}), train_ds, None,
+                 mesh=mesh8)
+    assert obs_xla.current() is None
+    assert obs_xla.note_throughput("train_chunk", 100.0) is None
+    assert obs_xla.poll_memory() is None
+
+
+# ------------------------------------------------- graceful degradation
+
+
+def test_harvest_degrades_on_unlowerable_fn(installed, tmp_path):
+    """A handle that refuses to lower (or analyze) degrades to ONE record
+    with null analysis fields — and never retries per-dispatch."""
+    reg, intro, metrics_path = installed
+
+    class Unlowerable:
+        calls = 0
+
+        def lower(self, *a, **k):
+            Unlowerable.calls += 1
+            raise RuntimeError("backend refuses AOT lowering")
+
+    fn = Unlowerable()
+    for _ in range(3):
+        obs_xla.harvest("weird", fn, (), {}, key=("geom",), examples=8)
+    assert Unlowerable.calls == 1   # marked seen BEFORE the attempt
+    recs = [json.loads(l) for l in open(metrics_path)]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "xla_program" and rec["program"] == "weird"
+    assert rec["flops"] is None and rec["compile_s"] is None
+    assert "error" in rec
+    # Schema-valid even in the degraded shape (keys present, values null).
+    assert vm.validate_lines(open(metrics_path)) == []
+    # No gauges for a program that produced no numbers; MFU no-ops.
+    assert not any(k.startswith("xla_") for k in _gauges())
+    assert intro.note_throughput("weird", 100.0) is None
+
+
+def test_harvest_degrades_on_empty_analysis(installed):
+    """A compiled handle returning empty/None analyses records nulls and
+    keeps the compile wall (which IS measurable) — sentry/gauges no-op on
+    the missing numbers instead of crashing."""
+    reg, intro, _ = installed
+
+    class EmptyCompiled:
+        def cost_analysis(self):
+            return []
+
+        def memory_analysis(self):
+            return None
+
+    class Lowerable:
+        def lower(self, *a, **k):
+            return self
+
+        def compile(self):
+            return EmptyCompiled()
+
+    obs_xla.harvest("sparse", Lowerable(), (), {}, key=(1,), examples=4)
+    rec = intro.programs["sparse"]
+    assert rec["flops"] is None and rec["peak_bytes"] is None
+    assert rec["compile_s"] >= 0
+    g = _gauges()
+    assert "xla_compile_s:sparse" in g and "xla_flops:sparse" not in g
+
+
+# --------------------------------------------------------------- MFU math
+
+
+def test_mfu_exact_with_env_peak(installed, monkeypatch):
+    """Per-device units: cost_analysis flops are PER-PARTITION on sharded
+    programs while examples are global, so flops_per_example is per-device —
+    MFU divides by the per-device peak, NOT the fleet total (that would
+    understate it by n_devices; measured on this jax)."""
+    reg, intro, _ = installed
+    monkeypatch.setenv("DDT_PEAK_FLOPS_PER_DEVICE", "1e9")
+    intro.programs["p"] = {"flops_per_example": 1000.0}
+    mfu = intro.note_throughput("p", 2000.0)
+    expected = 2000.0 * 1000.0 / 1e9   # no division by len(jax.devices())
+    assert mfu == pytest.approx(expected)
+    assert _gauges()["mfu:p"] == pytest.approx(expected, abs=1e-9)
+    assert intro.peak_flops_per_device() == (1e9, "env")
+
+
+def test_peak_flops_calibration_fallback(monkeypatch):
+    monkeypatch.delenv("DDT_PEAK_FLOPS_PER_DEVICE", raising=False)
+    peak, source = obs_xla.device_peak_flops()
+    # CPU backend: no table entry -> the measured-matmul calibration.
+    assert source == "calibrated" and peak > 1e8
+
+
+def test_tpu_peak_table_lookup(monkeypatch):
+    assert obs_xla.TPU_PEAK_FLOPS_PER_DEVICE["v4"] == 275e12
+    assert obs_xla.TPU_PEAK_FLOPS_PER_DEVICE["v5p"] > \
+        obs_xla.TPU_PEAK_FLOPS_PER_DEVICE["v4"]
+
+
+# ------------------------------------------------------- HBM watermarks
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+    def __str__(self):
+        return "FakeDevice(tpu:0)"
+
+
+def test_hbm_monitor_gauges_and_jump_records(installed, tmp_path,
+                                             monkeypatch):
+    reg, intro, metrics_path = installed
+    import jax
+    stats = {"bytes_in_use": 1000, "peak_bytes_in_use": 2000}
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [FakeDevice(stats)])
+    out = obs_xla.poll_memory()
+    assert out["peak_bytes"] == 2000
+    g = _gauges()
+    assert g["hbm_bytes_in_use"] == 1000 and g["hbm_peak_bytes"] == 2000
+    # +5% peak: below the 10% jump threshold -> gauge moves, no new record.
+    stats["peak_bytes_in_use"] = 2100
+    obs_xla.poll_memory()
+    # +50%: a watermark jump -> hbm_watermark record with the prev peak.
+    stats["peak_bytes_in_use"] = 3000
+    obs_xla.poll_memory()
+    recs = [json.loads(l) for l in open(metrics_path)
+            if json.loads(l)["kind"] == "hbm_watermark"]
+    assert len(recs) == 2   # first-poll baseline + the >=10% jump
+    assert recs[1]["peak_bytes"] == 3000 and recs[1]["prev_peak_bytes"] == 2000
+    assert vm.validate_lines(open(metrics_path)) == []
+
+
+def test_hbm_monitor_disables_on_none_stats(installed, monkeypatch):
+    """CPU-backend contract: memory_stats() is None -> the monitor disables
+    itself after one poll and later polls are free no-ops."""
+    import jax
+    calls = []
+
+    class NoneStatsDevice:
+        def memory_stats(self):
+            calls.append(1)
+            return None
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [NoneStatsDevice()])
+    assert obs_xla.poll_memory() is None
+    assert obs_xla.poll_memory() is None
+    assert len(calls) == 1
+    assert "hbm_peak_bytes" not in _gauges()
+
+
+# ------------------------------------------- obs.profile_dir capture window
+
+
+def _tree_files(root):
+    return [p for p in Path(root).rglob("*") if p.is_file()]
+
+
+def test_profile_dir_produces_trace_on_cpu(tmp_path, mesh8, tiny_ds):
+    """The dead-knob fix pinned: obs.profile_dir now yields a NON-EMPTY
+    jax.profiler trace directory on the CPU backend, captured from the
+    steady epoch, under the stage's tag."""
+    ProfileWindow.reset()
+    train_ds, _ = tiny_ds
+    cfg = _cfg(tmp_path, **{"obs.profile_dir": f"{tmp_path}/profile"})
+    try:
+        loop_mod.fit(cfg, train_ds, None, mesh=mesh8, tag="train")
+    finally:
+        ProfileWindow.reset()
+    files = _tree_files(tmp_path / "profile" / "train")
+    assert files, "profile window captured nothing"
+
+
+def test_profile_window_once_per_tag_and_capped(tmp_path):
+    ProfileWindow.reset()
+    try:
+        w = ProfileWindow(str(tmp_path), "t", start_epoch=0, num_epochs=3,
+                          window_chunks=2)
+        assert w.target_epoch == 1
+        w.tick(0)              # compile epoch: ignored
+        w.tick(1)              # starts the capture
+        assert ProfileWindow._active is w
+        w.tick(1)
+        w.tick(1)              # window budget reached -> stopped
+        assert ProfileWindow._active is None
+        assert "t" in ProfileWindow._captured_tags
+        # A second window for the same tag never starts.
+        w2 = ProfileWindow(str(tmp_path), "t", start_epoch=0, num_epochs=3)
+        w2.tick(1)
+        assert ProfileWindow._active is None
+        # The process-wide capture budget caps distinct tags.
+        ProfileWindow._captured_tags = {f"x{i}" for i in range(
+            ProfileWindow.MAX_CAPTURES)}
+        w3 = ProfileWindow(str(tmp_path), "fresh", start_epoch=0,
+                           num_epochs=3)
+        w3.tick(1)
+        assert ProfileWindow._active is None and w3._done
+    finally:
+        ProfileWindow.reset()
+
+
+def test_single_epoch_window_skips_compile_dispatch(tmp_path):
+    ProfileWindow.reset()
+    try:
+        w = ProfileWindow(str(tmp_path), "single", start_epoch=0,
+                          num_epochs=1, window_chunks=4)
+        assert w.target_epoch == 0 and w._skip == 1
+        w.tick(0)              # the compile-carrying first dispatch: skipped
+        assert ProfileWindow._active is None
+        w.tick(0)              # second dispatch: capture starts
+        assert ProfileWindow._active is w
+        w.epoch_end(0)
+        assert ProfileWindow._active is None
+    finally:
+        ProfileWindow.reset()
+
+
+# ------------------------------------------------------------ run summary
+
+
+def test_run_summary_carries_xla_block(installed, tmp_path, mesh8, tiny_ds):
+    from data_diet_distributed_tpu.obs import emit_run_summary
+    reg, intro, metrics_path = installed
+    train_ds, _ = tiny_ds
+    loop_mod.fit(_cfg(tmp_path), train_ds, None, mesh=mesh8)
+    logger = MetricsLogger(str(tmp_path / "summary.jsonl"), echo=False)
+    rec = emit_run_summary(logger, wall_s=1.0, exit_class="ok",
+                           command="train", registry=reg)
+    logger.close()
+    assert "train_chunk" in rec["xla"]
+    assert rec["xla"]["train_chunk"]["flops"] > 0
+    assert rec["mfu"] > 0
+    assert vm.validate_lines(open(tmp_path / "summary.jsonl")) == []
+
+
+def test_cli_run_emits_gauges_prom_and_ledger(tmp_path, mesh8):
+    """Acceptance: a CPU-lane CLI run emits MFU, flops, peak-bytes and
+    compile-time gauges into the metrics JSONL + Prometheus textfile and
+    appends one clean perf-history ledger record."""
+    from data_diet_distributed_tpu import cli
+    rc = cli.main([
+        "train", "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "train.num_epochs=2", "train.half_precision=false",
+        "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        f"obs.prom_path={tmp_path}/metrics.prom",
+        f"obs.perf_ledger={tmp_path}/perf_history.jsonl",
+        "obs.heartbeat_interval_s=0"])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert any(r["kind"] == "xla_program" and r["program"] == "train_chunk"
+               and r["flops"] > 0 for r in recs)
+    last = recs[-1]
+    assert last["kind"] == "run_summary"
+    assert last["xla"]["train_chunk"]["compile_s"] > 0
+    assert last["mfu"] > 0
+    gauges = [r for r in recs if r["kind"] == "metrics"][-1]["gauges"]
+    for g in ("mfu", "xla_flops:train_chunk", "xla_compile_s:train_chunk",
+              "xla_peak_bytes:train_chunk"):
+        assert gauges[g] > 0
+    prom = open(tmp_path / "metrics.prom").read()
+    for name in ("ddt_mfu", "ddt_xla_flops_train_chunk",
+                 "ddt_xla_compile_s_train_chunk",
+                 "ddt_xla_peak_bytes_train_chunk"):
+        assert f"{name} " in prom
+    import perf_sentry as ps   # tools/ is on sys.path (module header)
+    ledger = ps.load_ledger(str(tmp_path / "perf_history.jsonl"))
+    assert len(ledger) == 1
+    assert ps.classify_record(ledger[0]) == ps.CLEAN
+    assert ledger[0]["metric"] == "cli_train_wall_s"
+    assert ledger[0]["mfu"] > 0 and ledger[0]["examples_per_s"] > 0

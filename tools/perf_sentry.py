@@ -1,0 +1,274 @@
+"""Perf-regression sentry over the append-only run-history ledger.
+
+The official perf record went blind for two rounds (BENCH_r04/r05 recorded
+0.0 ex/s with no machine flagging the anomaly) because nothing compared a
+new number against the trail behind it. This tool does exactly that, over
+``artifacts/perf_history.jsonl`` — the ledger every ``bench.py`` run (and
+any CLI run with ``obs.perf_ledger`` set) appends one ``{"kind":
+"perf_history"}`` record to::
+
+    python tools/perf_sentry.py artifacts/perf_history.jsonl
+    python tools/perf_sentry.py ledger.jsonl --threshold 0.15 --json
+    python tools/perf_sentry.py --import-bench BENCH_r*.json \
+        --ledger artifacts/perf_history.jsonl        # one-shot backfill
+
+Per (metric, backend, geometry) group, the NEWEST record is compared against
+the trailing median of the last ``--window`` CLEAN records before it.
+Wedge-shaped records — an ``error`` field, a non-ok ``exit_class``, a
+missing/zero/negative value — are classified ``capture-error`` and can NEVER
+enter a baseline or count as a regression: a hung backend probe is a capture
+problem, not a 100% perf loss. ``unit`` decides direction ("seconds" =
+lower-better; everything else = higher-better).
+
+Exit-code contract (pinned by tests/test_perf_sentry.py)::
+
+    0  every group ok / improved (or has no baseline yet)
+    1  at least one regression past --threshold
+    2  no regression, but the newest record of some group is capture-error
+       (the capture path is blind again — fix it before trusting the trail)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_THRESHOLD = 0.10
+DEFAULT_WINDOW = 5
+
+#: Classification vocabulary for individual ledger records.
+CLEAN, CAPTURE_ERROR = "clean", "capture-error"
+
+#: Group statuses, most severe first (the run's exit code keys off these).
+REGRESSION, NEWEST_CAPTURE_ERROR = "regression", "newest-capture-error"
+IMPROVEMENT, OK, NO_BASELINE = "improvement", "ok", "no-baseline"
+
+EXIT_OK, EXIT_REGRESSION, EXIT_CAPTURE_ERROR = 0, 1, 2
+
+
+def classify_record(rec: dict) -> str:
+    """``capture-error`` for wedge-shaped records: an error string, a non-ok
+    exit class, or a value that cannot be a measurement (None/NaN/<=0 — both
+    throughputs and wall-seconds are strictly positive when real)."""
+    if rec.get("error"):
+        return CAPTURE_ERROR
+    if rec.get("exit_class") not in (None, "ok"):
+        return CAPTURE_ERROR
+    v = rec.get("value")
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return CAPTURE_ERROR
+    if v != v or v <= 0:
+        return CAPTURE_ERROR
+    return CLEAN
+
+
+def lower_is_better(rec: dict) -> bool:
+    return str(rec.get("unit", "")).lower() in ("seconds", "s")
+
+
+def group_key(rec: dict) -> str:
+    """Records are only comparable within the same (metric, backend,
+    geometry) shape; geometry dicts canonicalize by sorted keys. Backfilled
+    pre-ledger records carry neither backend nor geometry — their metric
+    name IS their identity."""
+    geom = rec.get("geometry")
+    if isinstance(geom, dict):
+        geom = json.dumps(geom, sort_keys=True)
+    return json.dumps([rec.get("metric", ""), rec.get("backend", ""),
+                       geom or ""])
+
+
+def load_ledger(path: str) -> list[dict]:
+    """Ledger records in APPEND order (the sentry's notion of time — every
+    writer appends atomically, so file order is run order). Non-JSON or
+    non-perf_history lines are skipped: the ledger may share a stream with
+    other record kinds."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "perf_history":
+                records.append(rec)
+    return records
+
+
+def _median(values: list[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def check_group(records: list[dict], *, threshold: float,
+                window: int) -> dict:
+    """Verdict for one group's records (append order): the newest record vs
+    the trailing median of the last ``window`` clean records before it."""
+    newest = records[-1]
+    out = {"metric": newest.get("metric"), "n_records": len(records),
+           "newest_value": newest.get("value"),
+           "classification": classify_record(newest)}
+    if out["classification"] == CAPTURE_ERROR:
+        out["status"] = NEWEST_CAPTURE_ERROR
+        out["error"] = str(newest.get("error", ""))[:200]
+        return out
+    clean = [r["value"] for r in records[:-1] if classify_record(r) == CLEAN]
+    if not clean:
+        out["status"] = NO_BASELINE
+        return out
+    baseline = _median(clean[-window:])
+    out["baseline_median"] = baseline
+    delta = (newest["value"] - baseline) / baseline
+    if lower_is_better(newest):
+        delta = -delta   # normalize: positive delta = better, either unit
+    out["delta_frac"] = round(delta, 4)
+    if delta < -threshold:
+        out["status"] = REGRESSION
+    elif delta > threshold:
+        out["status"] = IMPROVEMENT
+    else:
+        out["status"] = OK
+    return out
+
+
+def check_ledger(records: list[dict], *, threshold: float = DEFAULT_THRESHOLD,
+                 window: int = DEFAULT_WINDOW,
+                 metric: str | None = None) -> dict:
+    groups: dict[str, list[dict]] = {}
+    for rec in records:
+        if metric is not None and rec.get("metric") != metric:
+            continue
+        groups.setdefault(group_key(rec), []).append(rec)
+    results = [check_group(g, threshold=threshold, window=window)
+               for g in groups.values()]
+    capture_errors = sum(1 for r in records if classify_record(r)
+                         == CAPTURE_ERROR)
+    considered = [r for r in records
+                  if metric is None or r.get("metric") == metric]
+    if any(r["status"] == REGRESSION for r in results):
+        exit_code = EXIT_REGRESSION
+    elif considered and classify_record(considered[-1]) == CAPTURE_ERROR:
+        # The LAST appended record (not any group's newest — a group that
+        # stopped receiving records is stale, not blind) is wedge-shaped:
+        # the capture path is blind RIGHT NOW.
+        exit_code = EXIT_CAPTURE_ERROR
+    else:
+        exit_code = EXIT_OK
+    return {"groups": results, "records": len(records),
+            "capture_errors": capture_errors, "threshold": threshold,
+            "window": window, "exit_code": exit_code}
+
+
+# ------------------------------------------------------------- backfill
+
+def import_bench_artifact(path: str) -> dict:
+    """One driver BENCH_rNN.json -> one ledger record.
+
+    The driver format wraps bench.py's JSON line as ``{"n": round, "rc": ...,
+    "parsed": {...}}``. The round index stands in for ``ts`` (these artifacts
+    predate the ledger; only ordering matters to the sentry). A parsed line
+    carrying an ``error`` field (r04/r05's device-claim wedge) backfills as
+    exactly that — the sentry classifies it capture-error, the reason this
+    importer exists."""
+    with open(path) as fh:
+        art = json.load(fh)
+    parsed = art.get("parsed") or {}
+    rec = {
+        "kind": "perf_history", "ts": float(art.get("n", 0)),
+        "source": "bench_backfill", "round": art.get("n"),
+        "metric": parsed.get("metric", "unknown"),
+        "value": parsed.get("value"), "unit": parsed.get("unit", ""),
+        "artifact": os.path.basename(path),
+    }
+    for k in ("error", "exit_class", "vs_baseline"):
+        if parsed.get(k) is not None:
+            rec[k] = parsed[k]
+    if not parsed:
+        # The round produced NO parseable line (pre-hardening crash): record
+        # the driver's exit status as the error so the blind round is in the
+        # trail as a capture-error, not silently absent.
+        rec["error"] = f"no parseable bench JSON (driver rc {art.get('rc')})"
+    return rec
+
+
+def backfill(paths: list[str], ledger: str) -> list[dict]:
+    from data_diet_distributed_tpu.utils.io import atomic_append_jsonl
+    recs = sorted((import_bench_artifact(p) for p in paths),
+                  key=lambda r: r["ts"])
+    for rec in recs:
+        atomic_append_jsonl(ledger, rec)
+    return recs
+
+
+# ------------------------------------------------------------------ CLI
+
+def render(report: dict) -> str:
+    lines = [f"perf sentry: {report['records']} ledger records, "
+             f"{len(report['groups'])} group(s), "
+             f"{report['capture_errors']} capture-error record(s), "
+             f"threshold {report['threshold'] * 100:.0f}%"]
+    for g in sorted(report["groups"], key=lambda g: g["metric"] or ""):
+        line = f"  [{g['status']:>21}] {g['metric']}: {g['newest_value']}"
+        if g.get("baseline_median") is not None:
+            line += (f" vs median {round(g['baseline_median'], 2)}"
+                     f" ({g['delta_frac'] * 100:+.1f}%)")
+        if g.get("error"):
+            line += f" — {g['error']}"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Compare the newest perf-history record per "
+                    "(metric, backend, geometry) against its trailing median")
+    parser.add_argument("ledger", nargs="?", default=None,
+                        help="perf-history JSONL ledger to check")
+    parser.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                        help="regression fraction that fails the check "
+                             f"(default {DEFAULT_THRESHOLD})")
+    parser.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                        help="trailing clean records in the baseline median "
+                             f"(default {DEFAULT_WINDOW})")
+    parser.add_argument("--metric", default=None,
+                        help="check only this metric")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as one JSON object")
+    parser.add_argument("--import-bench", nargs="+", default=None,
+                        metavar="BENCH.json",
+                        help="one-shot backfill: append driver BENCH_rNN.json "
+                             "artifacts to --ledger (sorted by round), then "
+                             "exit 0")
+    parser.add_argument("--ledger", dest="ledger_out", default=None,
+                        help="ledger path for --import-bench")
+    args = parser.parse_args(argv)
+
+    if args.import_bench:
+        out = args.ledger_out or args.ledger
+        if not out:
+            parser.error("--import-bench needs --ledger <path>")
+        recs = backfill(args.import_bench, out)
+        print(f"backfilled {len(recs)} record(s) into {out}")
+        return 0
+    if not args.ledger:
+        parser.error("ledger path required (or use --import-bench)")
+    if not os.path.exists(args.ledger):
+        print(f"{args.ledger}: no ledger (no runs recorded yet)",
+              file=sys.stderr)
+        return EXIT_OK
+    report = check_ledger(load_ledger(args.ledger), threshold=args.threshold,
+                          window=args.window, metric=args.metric)
+    print(json.dumps(report) if args.json else render(report))
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

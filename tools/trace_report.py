@@ -8,6 +8,7 @@ four without opening a trace viewer::
     python tools/trace_report.py <workdir>/trace.json
     python tools/trace_report.py trace.json trace_rank1.json   # merged view
     python tools/trace_report.py trace.json --heartbeats ./ckpt_heartbeats
+    python tools/trace_report.py trace.json --metrics metrics.jsonl  # + XLA
     python tools/trace_report.py trace.json --json             # machine-readable
 
 Reads crashed-run traces too (the streamed format tolerates a missing
@@ -65,11 +66,36 @@ def summarize(events: list[dict], *, top_chunks: int = 5,
                     for name, durs in sorted(stages.items())}
 
     epochs: dict[str, list[float]] = {}
+    epoch_seq: dict[str, list[tuple[int, float]]] = {}
     for e in by_cat.get("epoch", []):
-        tag = (e.get("args") or {}).get("tag", "")
+        args = e.get("args") or {}
+        tag = args.get("tag", "")
         epochs.setdefault(tag, []).append(e["dur"])
+        epoch_seq.setdefault(tag, []).append((int(args.get("epoch", 0)),
+                                              e["dur"]))
     epoch_report = {tag: _dur_summary(durs)
                     for tag, durs in sorted(epochs.items())}
+
+    # Compile vs steady-state split: per fit tag, the FIRST epoch carries the
+    # stage's compiles (trace+lower+XLA) while the rest are steady state —
+    # the ratio says how much of a short stage's wall was compile tax.
+    # Merged per-rank traces contribute one epoch-0 span PER RANK, so the
+    # split averages every min-epoch span (not just the first after sorting —
+    # that would count one rank's compile and fold the others into steady).
+    compile_split = {}
+    for tag, seq in sorted(epoch_seq.items()):
+        min_ep = min(e for e, _ in seq)
+        first = [d / 1e6 for e, d in seq if e == min_ep]
+        steady = [d / 1e6 for e, d in seq if e != min_ep]
+        if not steady:
+            continue
+        first_s = sum(first) / len(first)
+        steady_mean = sum(steady) / len(steady)
+        compile_split[tag] = {
+            "compile_epoch_s": round(first_s, 4),
+            "steady_epoch_mean_s": round(steady_mean, 4),
+            "compile_overhead_s": round(max(first_s - steady_mean, 0.0), 4),
+            "ratio": round(first_s / steady_mean, 2) if steady_mean else None}
 
     chunk_spans = sorted(by_cat.get("chunk", []), key=lambda e: -e["dur"])
     slowest = [{"dur_s": round(e["dur"] / 1e6, 4), "pid": e.get("pid"),
@@ -93,9 +119,46 @@ def summarize(events: list[dict], *, top_chunks: int = 5,
     total_s = (points[-1] - points[0]) / 1e6 if len(points) > 1 else 0.0
     return {"events": len(events), "spans": len(spans),
             "trace_total_s": round(total_s, 3), "stages": stage_report,
-            "epochs": epoch_report, "chunks": chunk_report,
+            "epochs": epoch_report, "compile_split": compile_split,
+            "chunks": chunk_report,
             "slowest_chunks": slowest, "gaps": gaps[:5],
             "ranks": sorted({e.get("pid", 0) for e in spans})}
+
+
+def xla_section(metrics_path: str) -> dict:
+    """The XLA block from a run's metrics JSONL: the terminal run_summary's
+    per-program introspection harvest (flops, bytes, compile wall, peak-bytes
+    estimate) plus the registry's MFU / HBM / peak-flops gauges from the last
+    metrics snapshot — the compiled-program numbers next to the wall-clock
+    ones this tool derives from the trace."""
+    programs: dict = {}
+    gauges: dict = {}
+    try:
+        with open(metrics_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                kind = rec.get("kind")
+                if kind == "xla_program":
+                    programs[rec.get("program", "?")] = {
+                        k: rec.get(k) for k in
+                        ("geometry", "flops", "bytes_accessed", "compile_s",
+                         "peak_bytes", "arith_intensity")}
+                elif kind == "run_summary" and rec.get("xla"):
+                    programs.update(rec["xla"])
+                elif kind == "metrics":
+                    for g, v in (rec.get("gauges") or {}).items():
+                        if g == "mfu" or g.startswith(("mfu:", "hbm_",
+                                                       "xla_peak_flops")):
+                            gauges[g] = v
+    except OSError:
+        pass
+    return {"programs": programs, "gauges": gauges}
 
 
 def _fmt_summary(name: str, s: dict, width: int = 24) -> str:
@@ -116,6 +179,31 @@ def render(report: dict, heartbeats: dict[int, dict] | None = None,
         lines.append("per-epoch (by fit tag):")
         lines += [_fmt_summary(t or "<untagged>", s)
                   for t, s in report["epochs"].items()]
+    if report.get("compile_split"):
+        lines.append("compile vs steady-state (first epoch vs rest):")
+        for tag, s in report["compile_split"].items():
+            lines.append(
+                f"  {tag or '<untagged>':<24} compile epoch "
+                f"{s['compile_epoch_s']}s vs steady {s['steady_epoch_mean_s']}s"
+                f"  (+{s['compile_overhead_s']}s, x{s['ratio']})")
+    if report.get("xla"):
+        progs, gauges = report["xla"]["programs"], report["xla"]["gauges"]
+        if progs or gauges:
+            lines.append("XLA compiled programs (obs/xla.py harvest):")
+        for name, p in sorted(progs.items()):
+            flops = p.get("flops")
+            parts = [f"flops {flops:.3e}" if flops else "flops n/a"]
+            if p.get("bytes_accessed"):
+                parts.append(f"bytes {p['bytes_accessed']:.3e}")
+            if p.get("arith_intensity"):
+                parts.append(f"AI {p['arith_intensity']}")
+            if p.get("compile_s") is not None:
+                parts.append(f"compile {p['compile_s']}s")
+            if p.get("peak_bytes"):
+                parts.append(f"peak~{p['peak_bytes'] / 1e6:.1f}MB")
+            lines.append(f"  {name:<24} " + "  ".join(parts))
+        for g, v in sorted(gauges.items()):
+            lines.append(f"  {g:<24} {v}")
     if report["chunks"]:
         lines.append("chunk dispatches:")
         lines.append(_fmt_summary("all chunks", report["chunks"]))
@@ -144,6 +232,11 @@ def main(argv: list[str] | None = None) -> int:
                         "multiple files (per-rank traces) are merged")
     parser.add_argument("--heartbeats", default=None,
                         help="heartbeat directory to report rank ages from")
+    parser.add_argument("--metrics", default=None,
+                        help="metrics JSONL to source the XLA section from "
+                             "(per-program flops/bytes/compile-time from the "
+                             "xla_program records, MFU/HBM gauges from the "
+                             "registry snapshots)")
     parser.add_argument("--top-chunks", type=int, default=5)
     parser.add_argument("--gap-threshold", type=float, default=DEFAULT_GAP_S,
                         help="report inter-event gaps at least this long (s)")
@@ -159,6 +252,8 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     report = summarize(events, top_chunks=args.top_chunks,
                        gap_threshold_s=args.gap_threshold)
+    if args.metrics is not None:
+        report["xla"] = xla_section(args.metrics)
     beats = (read_heartbeats(args.heartbeats)
              if args.heartbeats is not None else None)
     if args.json:

@@ -43,6 +43,9 @@ KNOWN_KINDS = frozenset({
     "consensus",
     # observability layer
     "metrics", "run_summary",
+    # XLA/device introspection (obs/xla.py) + the perf-history ledger
+    # (tools/perf_sentry.py reads streams of the latter)
+    "xla_program", "hbm_watermark", "perf_history",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -55,6 +58,12 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "epoch": ("epoch", "train_loss"),
     "run_summary": ("wall_s", "exit_class"),
     "metrics": ("counters", "gauges", "histograms"),
+    # compile_s/flops may be null (a backend refusing to analyze degrades,
+    # never crashes) but the KEYS must be present — a consumer can rely on
+    # the shape.
+    "xla_program": ("program", "compile_s", "flops"),
+    "hbm_watermark": ("device", "bytes_in_use", "peak_bytes"),
+    "perf_history": ("source", "metric", "value", "unit"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
