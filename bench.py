@@ -104,7 +104,8 @@ def _append_ledger(line: dict) -> None:
         rec = {"kind": "perf_history", "ts": round(time.time(), 3),
                "source": "bench", "geometry": _LEDGER["geometry"]}
         for k in ("metric", "value", "unit", "vs_baseline", "error",
-                  "exit_class", "chunk_steps", "mfu", "pass_s"):
+                  "exit_class", "chunk_steps", "mfu", "pass_s",
+                  "score_stability"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -219,9 +220,14 @@ def main() -> None:
                              "method (was --chunk's meaning before the "
                              "chunked score engine)")
     parser.add_argument("--repeats", type=int, default=3)
-    parser.add_argument("--seeds", type=int, default=10,
+    parser.add_argument("--seeds", type=int, default=None,
                         help="northstar task: number of scoring models "
-                             "(BASELINE: 10)")
+                             "(default 10, the BASELINE protocol). score "
+                             "task: seeds for the embedded score-quality "
+                             "block (per-seed score_stats + cross-seed "
+                             "stability when >= 2; untimed, after the "
+                             "measured passes — default 2, the cheapest "
+                             "stability measurement)")
     parser.add_argument("--mesh", default=None,
                         help="mesh layout DxM (e.g. 4x2 = 4-way data x 2-way "
                              "tensor parallel); default: all devices on data. "
@@ -270,6 +276,11 @@ def main() -> None:
                         help="also write the registry's Prometheus textfile "
                              "(MFU/flops/compile-time/HBM gauges) here")
     args = parser.parse_args()
+    if args.seeds is None:
+        # Task-aware default: the northstar workload IS 10 scoring models;
+        # the score task's quality block is an untimed rider whose default
+        # must not multiply a large bench's wall several-fold.
+        args.seeds = 10 if args.task == "northstar" else 2
 
     if not args.no_ledger and args.process_id == 0:
         _LEDGER["path"] = args.ledger
@@ -524,8 +535,68 @@ def bench_score(args, metric: str) -> None:
     extra.update(chunk_steps=k_chunk, dispatches_per_epoch=dispatches,
                  dispatches_per_sec=round(dispatches / mean_pass, 2))
     extra.update(_xla_extras("score_chunk", examples_per_sec))
+    extra.update(_score_quality_block(args, model, train_ds, mesh, sharder,
+                                      batch_size))
     emit(metric, round(per_chip, 1), "examples/sec/chip",
          round(vs_baseline, 4), **extra)
+
+
+def _score_quality_block(args, model, train_ds, mesh, sharder,
+                         batch_size: int) -> dict:
+    """Score QUALITY next to the throughput claim: ``--seeds`` scoring
+    models' per-seed score_stats summaries and (seeds >= 2) the cross-seed
+    stability block, computed through the production ``score_dataset``
+    driver with a bench-local Scoreboard. Untimed — runs AFTER the measured
+    passes, so the headline value is unaffected; ``tools/perf_sentry.py``
+    can then track rank stability alongside examples/sec without a schema
+    change (the stability block rides the perf-history ledger record).
+    Best-effort by the bench contract: a failure here degrades to a stderr
+    note, never zeroes a successfully measured throughput."""
+    import jax
+
+    from data_diet_distributed_tpu.obs import scoreboard as obs_scoreboard
+    from data_diet_distributed_tpu.ops.scoring import score_dataset
+    from data_diet_distributed_tpu.parallel.mesh import replicate
+    try:
+        init = jax.jit(model.init, static_argnames=("train",))
+        sample = np.zeros((1, *train_ds.images.shape[1:]), np.float32)
+        seeds = list(range(max(1, args.seeds)))
+        seeds_vars = [replicate(init(jax.random.key(s), sample, train=False),
+                                mesh) for s in seeds]
+        board = obs_scoreboard.Scoreboard()   # local: no JSONL, gauges only
+        prev = obs_scoreboard.current()
+        obs_scoreboard.install(board)
+        try:
+            score_dataset(model, seeds_vars, train_ds, method=args.method,
+                          batch_size=batch_size, sharder=sharder,
+                          chunk=args.grand_chunk, chunk_steps=args.chunk,
+                          use_pallas=False if args.no_pallas else None,
+                          seed_ids=seeds)
+        finally:
+            if prev is not None:
+                obs_scoreboard.install(prev)
+            else:
+                obs_scoreboard.uninstall()
+        per_seed = []
+        for s, vec in sorted(board.seed_stats(args.method).items()):
+            st = obs_scoreboard.score_stats(vec)
+            per_seed.append({"seed": s,
+                             **{k: st[k] for k in
+                                ("mean", "std", "p5", "p95", "max")},
+                             "nonfinite": st["nan_count"] + st["inf_count"]})
+        out: dict = {"score_stats": per_seed}
+        stab = board.note_stability(args.method, keep_fractions=(0.5,))
+        if stab is not None:
+            out["score_stability"] = {k: stab[k] for k in
+                                      ("n_seeds", "spearman_pairwise_mean",
+                                       "spearman_pairwise_min",
+                                       "spearman_vs_mean_mean",
+                                       "overlap_at_keep")}
+        return out
+    except Exception as exc:   # noqa: BLE001 — quality block must not mask
+        print(f"[bench] score-quality block failed: {exc!r}", file=sys.stderr,
+              flush=True)
+        return {}
 
 
 def _xla_extras(program: str, examples_per_sec: float | None) -> dict:

@@ -132,6 +132,12 @@ def main(argv: list[str] | None = None) -> int:
             try:
                 written = plot_metrics(cfg.obs.metrics_path, cfg.obs.plots_dir,
                                        since_ts=run_started)
+                # Per-seed score distributions from the stream's score_stats
+                # records (no npz needed — works for crashed runs too).
+                from .obs import plot_score_stats
+                written += plot_score_stats(cfg.obs.metrics_path,
+                                            cfg.obs.plots_dir,
+                                            since_ts=run_started)
                 if command in ("run", "score"):
                     from .obs import plot_scores
                     from .train.loop import scores_npz_path

@@ -326,6 +326,19 @@ class ObsConfig:
     # steady-state window of this many chunk dispatches per pipeline stage
     # (the compile epoch is skipped), one capture per stage tag.
     profile_window_chunks: int = 8
+    # Score Observatory (obs/scoreboard.py): per-(method, seed) score
+    # distribution records ({"kind": "score_stats"}: moments/percentiles/
+    # bounded histogram/NaN counts + score_* gauges), cross-seed rank
+    # stability after multi-seed passes ({"kind": "score_stability"}:
+    # pairwise Spearman ρ, mean-vs-seed ρ, overlap@k at the configured keep
+    # fractions, surfaced in run_summary), and the prune stage's
+    # {"kind": "prune_decision"} record next to the provenance sidecar
+    # manifest. Host math once per SEED pass over already-fetched arrays —
+    # no extra device dispatches.
+    score_telemetry: bool = True
+    # Fixed bin count of the histogram embedded in each score_stats record
+    # (bounded by construction regardless of dataset size).
+    score_hist_bins: int = 32
     # Append-only perf-history ledger (JSONL; tools/perf_sentry.py compares
     # runs across time): every run appends one {"kind": "perf_history"}
     # record at exit. None = off (bench.py keeps its own default ON — the
@@ -447,6 +460,9 @@ class Config:
         if o.hbm_jump_frac <= 0:
             raise ValueError(
                 f"obs.hbm_jump_frac must be > 0, got {o.hbm_jump_frac}")
+        if o.score_hist_bins < 1:
+            raise ValueError(
+                f"obs.score_hist_bins must be >= 1, got {o.score_hist_bins}")
         return self
 
 

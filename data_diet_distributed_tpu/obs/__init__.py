@@ -1,18 +1,21 @@
-from . import flightrec, heartbeat, registry, tracing, xla
+from . import flightrec, heartbeat, registry, scoreboard, tracing, xla
 from .flightrec import FlightRecorder
 from .heartbeat import Heartbeat
 from .metrics import MetricsLogger, emit_run_summary
 from .monitor import ResourceMonitor, sample_devices
-from .plots import plot_metrics, plot_scores, plot_utilization
+from .plots import (plot_metrics, plot_score_stats, plot_scores,
+                    plot_utilization)
 from .profiler import ProfileWindow, StepTimer, trace
 from .registry import MetricsRegistry
+from .scoreboard import Scoreboard
 from .session import ObsSession
 from .tracing import Tracer
 from .xla import HbmMonitor, XlaIntrospector
 
 __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
-           "trace", "plot_metrics", "plot_scores", "plot_utilization",
+           "trace", "plot_metrics", "plot_scores", "plot_score_stats",
+           "plot_utilization",
            "Tracer", "MetricsRegistry", "Heartbeat", "FlightRecorder",
            "ObsSession", "emit_run_summary", "tracing", "registry",
            "heartbeat", "flightrec", "xla", "XlaIntrospector", "HbmMonitor",
-           "ProfileWindow"]
+           "ProfileWindow", "scoreboard", "Scoreboard"]

@@ -126,6 +126,13 @@ def emit_run_summary(logger: MetricsLogger, *, wall_s: float, exit_class: str,
             mfu = registry.snapshot()["gauges"].get("mfu")
             if mfu is not None:
                 record["mfu"] = mfu
+    from . import scoreboard as obs_scoreboard
+    stability = obs_scoreboard.summary()
+    if stability:
+        # Score Observatory block: per-method cross-seed agreement (mean
+        # pairwise Spearman ρ, overlap@keep) — the statistic a parity or
+        # reproduction claim about this run's scores would cite.
+        record["score_stability"] = stability
     if final:
         record["final"] = {k: v for k, v in final.items() if v is not None}
     logger.log("run_summary", **record)

@@ -186,6 +186,65 @@ def plot_scores(npz_path: str, out_dir: str = "./plots",
     return [path]
 
 
+def score_hist_series(records: list[dict]) -> dict[str, list[tuple]]:
+    """The score-histogram data a ``plot_score_stats`` chart draws, extracted
+    pure (the direct-test seam): ``{method: [(seed, edges, counts), ...]}``
+    from the stream's ``score_stats`` records — latest record per (method,
+    seed) wins (appended logs may span runs), records without a histogram
+    (all-NaN vectors) are skipped."""
+    latest: dict[tuple, tuple] = {}
+    for r in records:
+        if r.get("kind") != "score_stats":
+            continue
+        hist = r.get("hist")
+        if not isinstance(hist, dict) or not hist.get("counts"):
+            continue
+        latest[(str(r.get("method")), r.get("seed"))] = (
+            hist["edges"], hist["counts"])
+    series: dict[str, list[tuple]] = {}
+    for (method, seed), (edges, counts) in sorted(
+            latest.items(), key=lambda kv: (kv[0][0], str(kv[0][1]))):
+        series.setdefault(method, []).append((seed, edges, counts))
+    return series
+
+
+def plot_score_stats(metrics_path: str, out_dir: str = "./plots",
+                     since_ts: float = 0.0) -> list[str]:
+    """Render the Score Observatory's per-seed score distributions — one PNG
+    per method, every seed's bounded histogram (from the ``score_stats``
+    records' exact bin edges/counts, NOT re-binned) as a step outline.
+
+    Unlike ``plot_scores`` this needs no npz: crashed runs that never reached
+    the prune stage still have their per-seed distributions in the stream.
+    """
+    plt = _mpl()
+    if plt is None or not os.path.exists(metrics_path):
+        return []
+    records = [r for r in _read_jsonl(metrics_path)
+               if r.get("ts", 0.0) >= since_ts]
+    series = score_hist_series(records)
+    if not series:
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    written: list[str] = []
+    for method, seeds in series.items():
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for seed, edges, counts in seeds:
+            # The record's exact bins: drawsteps between consecutive edges.
+            ax.stairs(counts, edges, label=f"seed {seed}")
+        if len(seeds) <= 10:
+            ax.legend(fontsize=7)
+        ax.set_xlabel("score")
+        ax.set_ylabel("examples")
+        ax.set_title(f"score distribution per seed ({method})")
+        fig.tight_layout()
+        path = os.path.join(out_dir, f"score_stats_{method}.png")
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
 def plot_metrics(metrics_path: str, out_dir: str = "./plots",
                  since_ts: float = 0.0) -> list[str]:
     """Render loss / accuracy / throughput curves from the MetricsLogger JSONL.

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import os
 
-from . import flightrec, heartbeat, registry, tracing, xla
+from . import flightrec, heartbeat, registry, scoreboard, tracing, xla
 from .profiler import ProfileWindow
 
 DEFAULT_TRACE_NAME = "trace.json"
@@ -48,6 +48,7 @@ class ObsSession:
         self.heartbeat: heartbeat.Heartbeat | None = None
         self.recorder: flightrec.FlightRecorder | None = None
         self.xla: xla.XlaIntrospector | None = None
+        self.scoreboard: scoreboard.Scoreboard | None = None
 
     def __enter__(self) -> "ObsSession":
         import jax
@@ -75,6 +76,12 @@ class ObsSession:
                 xla.XlaIntrospector(logger=self.logger),
                 xla.HbmMonitor(logger=self.logger,
                                jump_frac=cfg.obs.hbm_jump_frac))
+        if cfg.obs.score_telemetry:
+            # Score Observatory: per-(method, seed) score_stats records +
+            # cross-seed stability — the scoring paths reach it through the
+            # module slot (one is-None check when disabled).
+            self.scoreboard = scoreboard.install(scoreboard.Scoreboard(
+                logger=self.logger, bins=cfg.obs.score_hist_bins))
         # A session is a fresh run: clear the process-wide profile-window
         # bookkeeping so this run's stages can capture again (tests enter
         # many sessions per process).
@@ -96,6 +103,7 @@ class ObsSession:
                 self.registry.write_prometheus(self.registry.prom_path)
             except OSError:
                 pass   # a dying disk must not mask the run's own outcome
+        scoreboard.uninstall()
         xla.uninstall()
         flightrec.uninstall()
         heartbeat.uninstall()

@@ -18,6 +18,7 @@ import numpy as np
 from ..data.datasets import ArrayDataset, make_position_joiner
 from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              num_batches)
+from ..obs import scoreboard as obs_scoreboard
 from .scores import make_score_chunk, make_score_step
 
 #: Hard clamp on the score-chunk length (batches per dispatch): the chunk is
@@ -81,7 +82,8 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   eval_mode: bool = True, use_pallas: bool | None = None,
                   score_step=None, device_resident: bool | None = None,
                   chunk_steps: int | None = None,
-                  on_seed_done=None) -> np.ndarray:
+                  on_seed_done=None, seed_ids: Sequence[int] | None = None
+                  ) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 
     ``variables_seeds`` is a sequence of model variable pytrees (one per scoring seed);
@@ -108,6 +110,13 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     run loses at most the in-flight seed's pass. The hook may raise (e.g.
     ``Preempted`` at a seed boundary); completed seeds' hooks have already
     run.
+
+    Every completed seed pass also feeds the Score Observatory
+    (``obs/scoreboard.py``, no-op until installed): one ``score_stats``
+    record per (method, seed) from the just-fetched host array.
+    ``seed_ids`` labels the passes with the caller's true seed values
+    (``compute_scores`` passes its seed list); the pass index is the label
+    otherwise.
     """
     mesh = sharder.mesh if sharder is not None else None
     if sharder is not None and len(sharder.axes) < len(mesh.axis_names):
@@ -149,7 +158,7 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                 model, variables_seeds, ds, method=method,
                 batch_size=batch_size, sharder=sharder, chunk=chunk,
                 eval_mode=eval_mode, use_pallas=use_pallas, k_chunk=k_chunk,
-                on_seed_done=on_seed_done)
+                on_seed_done=on_seed_done, seed_ids=seed_ids)
 
     def device_batches():
         if sharder is not None:
@@ -188,6 +197,11 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                 flush()
         flush()
         total += seed_scores
+        # Observatory note BEFORE the caller hook: on_seed_done may raise
+        # (seed-boundary Preempted) and the completed pass's stats belong in
+        # the stream either way.
+        obs_scoreboard.note_seed_scores(
+            method, seed_ids[k] if seed_ids is not None else k, seed_scores)
         if on_seed_done is not None:
             on_seed_done(k, seed_scores)
     return (total / len(variables_seeds)).astype(np.float32)
@@ -256,7 +270,8 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
                            *, method: str, batch_size: int,
                            sharder: BatchSharder | None, chunk: int,
                            eval_mode: bool, use_pallas: bool | None,
-                           k_chunk: int, on_seed_done=None) -> np.ndarray:
+                           k_chunk: int, on_seed_done=None,
+                           seed_ids: Sequence[int] | None = None) -> np.ndarray:
     """The dispatch-free score epoch: the dataset uploaded ONCE as pre-batched
     pre-sharded blocks (``ScoreResident``), then each seed's whole pass is
     ``ceil(nb / K)`` chunked dispatches — one, on the default auto sizing —
@@ -279,6 +294,8 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
             [np.asarray(o, np.float64) for o in jax.device_get(outs)],
             axis=0).reshape(-1)[:resident.n]
         total += seed_scores
+        obs_scoreboard.note_seed_scores(
+            method, seed_ids[k] if seed_ids is not None else k, seed_scores)
         if on_seed_done is not None:
             on_seed_done(k, seed_scores)
     return (total / len(variables_seeds)).astype(np.float32)

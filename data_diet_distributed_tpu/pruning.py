@@ -14,7 +14,12 @@ phase boundaries (never loader objects — SURVEY §2.4.2).
 
 from __future__ import annotations
 
+import hashlib
+import json
+
 import numpy as np
+
+from .utils.io import atomic_write_json, provenance_path
 
 
 def num_kept(n: int, sparsity: float) -> int:
@@ -81,3 +86,112 @@ def select_indices(scores: np.ndarray, indices: np.ndarray, sparsity: float,
     kept = np.sort(indices[chosen])
     assert len(kept) == k  # reference keeps this invariant (get_scores_and_prune.py:29)
     return kept
+
+
+# ------------------------------------------------- prune-decision provenance
+
+#: Bump when the manifest's field set changes incompatibly.
+PRUNE_MANIFEST_VERSION = 1
+
+#: How many extreme examples (hardest / easiest, with scores) a manifest
+#: records — enough to eyeball what a prune considered load-bearing, small
+#: enough that the sidecar stays a few KB at any dataset size.
+MANIFEST_EXTREMES_K = 10
+
+
+def index_digest(ids) -> str:
+    """Order-independent digest of a global-id set (sha256 of the SORTED
+    int64 bytes, 16 hex chars) — the currency the retrain-stage audit
+    compares: two index sets match iff their digests do."""
+    arr = np.sort(np.asarray(ids, np.int64))
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:16]
+
+
+def build_prune_manifest(scores: np.ndarray, indices: np.ndarray,
+                         kept: np.ndarray, *, method: str, sparsity: float,
+                         keep: str = "hardest", class_balance: bool = False,
+                         seed: int = 0, fingerprint: str | None = None,
+                         extremes_k: int = MANIFEST_EXTREMES_K) -> dict:
+    """The provenance record of ONE prune decision: which examples a retrain
+    will train on, and why. Pure host math over the arrays the prune already
+    holds; deterministic, so every rank builds the identical manifest.
+
+    ``threshold_score`` is the decision boundary for the global threshold
+    policies (min kept score for hardest, max for easiest) — None for random
+    and for class-balanced pruning (per-class cuts have no single global
+    threshold, the same caveat ``obs/plots.plot_scores`` draws)."""
+    scores = np.asarray(scores)
+    indices = np.asarray(indices)
+    kept = np.asarray(kept)
+    kept_mask = np.isin(indices, kept)
+    dropped = np.sort(indices[~kept_mask])
+    threshold = None
+    if keep in ("hardest", "easiest") and not class_balance and kept_mask.any():
+        cut = (scores[kept_mask].min() if keep == "hardest"
+               else scores[kept_mask].max())
+        threshold = float(cut) if np.isfinite(cut) else None
+    # Extremes over the FINITE scores only: a NaN-scored example is neither
+    # hardest nor easiest (it is counted in nonfinite_scores), and both the
+    # sidecar and the prune_decision JSONL record must stay strict-JSON
+    # (no bare NaN tokens). Descending and ascending orders are computed
+    # separately so non-finite rows fall off BOTH ends, with the same
+    # (score, id asc) tie-break as select_indices.
+    finite = np.isfinite(scores)
+    hard_order = np.lexsort((indices, np.where(finite, -scores, np.inf)))
+    easy_order = np.lexsort((indices, np.where(finite, scores, np.inf)))
+    n_finite = int(finite.sum())
+    top = [{"index": int(indices[i]), "score": float(scores[i])}
+           for i in hard_order[:min(extremes_k, n_finite)]]
+    bottom = [{"index": int(indices[i]), "score": float(scores[i])}
+              for i in easy_order[:min(extremes_k, n_finite)]]
+    return {
+        "version": PRUNE_MANIFEST_VERSION,
+        "fingerprint": fingerprint,
+        "method": method,
+        "sparsity": float(sparsity),
+        "keep": keep,
+        "class_balance": bool(class_balance),
+        "seed": int(seed),
+        "n_total": int(len(scores)),
+        "n_kept": int(len(kept)),
+        "n_dropped": int(len(dropped)),
+        "nonfinite_scores": int((~np.isfinite(scores)).sum()),
+        "threshold_score": threshold,
+        "kept_digest": index_digest(kept),
+        "dropped_digest": index_digest(dropped),
+        "scores_digest": hashlib.sha256(
+            np.ascontiguousarray(np.asarray(scores, np.float32))
+            .tobytes()).hexdigest()[:16],
+        "top_k": top,
+        "bottom_k": bottom,
+    }
+
+
+def write_prune_manifest(npz_path: str, manifest: dict) -> str:
+    """Atomic sidecar write next to the scores npz; returns the path."""
+    path = provenance_path(npz_path)
+    atomic_write_json(path, manifest)
+    return path
+
+
+def verify_prune_manifest(npz_path: str, kept: np.ndarray) -> dict:
+    """The retrain-stage audit: the subset a retrain is handed must be
+    EXACTLY the one the manifest records. Mismatch (a clobbered artifact, a
+    scores/manifest pair from different runs, a bug in the join) raises a
+    loud ValueError naming both digests — a model silently trained on the
+    wrong subset is the one failure mode provenance exists to prevent.
+    Returns the verified manifest."""
+    path = provenance_path(npz_path)
+    with open(path) as fh:
+        manifest = json.load(fh)
+    got = index_digest(kept)
+    want = manifest.get("kept_digest")
+    if got != want or int(len(kept)) != manifest.get("n_kept"):
+        raise ValueError(
+            f"{path}: prune-provenance mismatch — the retrain was handed "
+            f"{len(kept)} kept examples (digest {got}) but the manifest "
+            f"records n_kept={manifest.get('n_kept')} (digest {want}). The "
+            "scores npz and its sidecar do not describe this subset; "
+            "recompute the prune (or delete the stale artifacts) rather "
+            "than training on an unauditable subset")
+    return manifest

@@ -37,11 +37,13 @@ from ..models import create_model_from_cfg
 from ..obs import MetricsLogger, flightrec, tracing
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
+from ..obs import scoreboard as obs_scoreboard
 from ..obs import xla as obs_xla
 from ..obs.profiler import ProfileWindow
 from ..ops.scoring import score_dataset
 from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
-from ..pruning import select_indices
+from ..pruning import (build_prune_manifest, select_indices,
+                       verify_prune_manifest, write_prune_manifest)
 from ..resilience import inject
 from ..resilience.consensus import Consensus
 from ..resilience.preemption import Preempted, PreemptionHandler
@@ -49,7 +51,7 @@ from ..resilience.sentinel import DivergenceError, LossSentinel
 from ..resilience.stages import (ScorePartialStore, StageManifest,
                                  score_partials_dir, stage_manifest_path)
 from ..resilience.watchdog import Watchdog, WatchdogTimeout
-from ..utils.io import atomic_savez
+from ..utils.io import atomic_savez, load_scores_npz, provenance_path
 from .state import TrainState, create_train_state
 from .steps import (make_eval_chunk, make_eval_step, make_train_chunk,
                     make_train_step)
@@ -942,6 +944,8 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
         for s in cfg.score.seeds:
             if int(s) in done:
                 total += done[int(s)]
+                obs_scoreboard.note_seed_scores(method, int(s), done[int(s)],
+                                                resumed=True)
                 continue
             tracker = make_tracker(n)
 
@@ -981,6 +985,7 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
                 rec.update(mean_margin=float(tracker.scores().mean()))
             logger.log(f"{method}_seed_done", **rec)
             seed_scores = np.asarray(tracker.scores(), np.float64)
+            obs_scoreboard.note_seed_scores(method, int(s), seed_scores)
             total += seed_scores
             completed += 1
             if partials is not None:
@@ -996,6 +1001,17 @@ def trajectory_scores(cfg: Config, train_ds: ArrayDataset, *,
 
 # Back-compat name (tests/multihost_worker.py and external callers).
 forgetting_scores = trajectory_scores
+
+
+def keep_fractions(cfg: Config) -> tuple[float, ...]:
+    """The keep fractions this config's prune decisions will use — the k's
+    the stability overlap@k statistic is computed at (sweep levels when set,
+    else the single sparsity; 0.5 when the run never prunes, so a
+    score-only command still reports a comparable default)."""
+    levels = cfg.prune.sweep or (
+        (cfg.prune.sparsity,) if 0.0 < cfg.prune.sparsity < 1.0 else ())
+    fracs = sorted({round(1.0 - float(s), 6) for s in levels})
+    return tuple(fracs) or (0.5,)
 
 
 def _score_partial_store(cfg: Config, train_ds: ArrayDataset, logger,
@@ -1043,6 +1059,14 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
         scores, timings = _compute_scores(cfg, train_ds, mesh=mesh,
                                           sharder=sharder, logger=logger,
                                           stages=stages)
+    # Cross-seed rank stability (Score Observatory): the per-seed vectors
+    # the pass just produced (computed or resumed) agree — or don't — on the
+    # ranking pruning will consume; emitted once per multi-seed pass, at the
+    # keep fractions this run's prune decisions will actually use. Host math
+    # over retained arrays; no-op when no Scoreboard is installed or the
+    # pass had fewer than two seeds.
+    obs_scoreboard.note_stability(cfg.score.method,
+                                  keep_fractions=keep_fractions(cfg))
     obs_registry.observe("score_s", timings["score_s"])
     obs_registry.observe("score_pretrain_s", timings["pretrain_s"])
     if timings.get("passes") and timings["score_s"] > 0:
@@ -1085,8 +1109,13 @@ def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
         logger.log("score_seeds_resumed", method=cfg.score.method,
                    done=sorted(done), todo=todo)
     total = np.zeros(len(train_ds), np.float64)
-    for arr in done.values():
+    for s, arr in done.items():
         total += arr
+        # Resumed seeds feed the Observatory from their durable partials —
+        # the stream describes EVERY seed the mean includes, recomputed or
+        # resumed (no-op until a Scoreboard is installed).
+        obs_scoreboard.note_seed_scores(cfg.score.method, s, arr,
+                                        resumed=True)
     pretrain_s = score_s = 0.0
     passes = 0
     if todo:
@@ -1126,7 +1155,12 @@ def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                           eval_mode=cfg.score.eval_mode,
                           use_pallas=cfg.score.use_pallas,
                           chunk_steps=cfg.score.chunk_steps,
-                          on_seed_done=on_seed_done)
+                          on_seed_done=on_seed_done,
+                          # A fixed-checkpoint pass has ONE scoring model
+                          # that is not seed 0 — label it by pass index.
+                          seed_ids=(todo if partials is not None
+                                    or cfg.score.score_ckpt_step is None
+                                    else None))
             score_s = time.perf_counter() - t1
         passes = len(seeds_vars)
     divisor = len(seeds) if partials is not None else max(passes, 1)
@@ -1138,57 +1172,9 @@ def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                     "passes": passes}
 
 
-def load_scores_npz(path: str, train_ds: ArrayDataset,
-                    expect_method: str | None = None) -> np.ndarray:
-    """Scores from a saved artifact, re-joined to ``train_ds`` row order by
-    GLOBAL index (the artifact may cover a superset or a different ordering of
-    the dataset; any dataset example missing from the artifact refuses
-    loudly via the position joiner's KeyError).
-
-    A truncated or corrupt file (a crash mid-write predating the atomic
-    writers, flaky storage) raises a ``ValueError`` NAMING THE PATH instead
-    of an opaque zip/zlib deserialization error. ``expect_method``: refuse an
-    artifact whose recorded scoring method differs — reusing EL2N scores for
-    a GraNd experiment would silently mix scoring methods. Artifacts without
-    a recorded method (pre-provenance) and ``reused:``-provenance records
-    (already reused once — the original method is unrecoverable) load
-    unchecked."""
-    import zipfile
-    import zlib
-
-    from ..data.datasets import make_position_joiner
-
-    try:
-        with np.load(path, allow_pickle=False) as d:
-            present = set(d.files)
-            scores = (np.asarray(d["scores"]) if "scores" in present else None)
-            indices = (np.asarray(d["indices"]) if "indices" in present
-                       else None)
-            method = str(d["method"]) if "method" in present else None
-    except FileNotFoundError:
-        raise
-    except (OSError, EOFError, ValueError, zipfile.BadZipFile,
-            zlib.error) as err:
-        raise ValueError(
-            f"{path}: truncated or corrupt scores artifact ({err!r}) — "
-            "recompute the scores (unset score.scores_npz) or point at an "
-            "intact artifact") from err
-    if scores is None or indices is None:
-        raise ValueError(
-            f"{path} is not a scores artifact (needs 'scores' and "
-            "'indices' arrays, as written by the run/score/sweep commands)")
-    if scores.shape != indices.shape:
-        raise ValueError(
-            f"{path}: scores shape {scores.shape} does not match indices "
-            f"shape {indices.shape} — truncated or malformed artifact")
-    if (expect_method is not None and method is not None
-            and not method.startswith("reused:") and method != expect_method):
-        raise ValueError(
-            f"{path} holds {method!r} scores but this run is configured for "
-            f"score.method={expect_method!r} — reusing them would silently "
-            f"mix scoring methods; set score.method={method} or recompute")
-    pos = make_position_joiner(indices)(train_ds.indices)
-    return scores[pos].astype(np.float32)
+# load_scores_npz moved to utils/io.py (the artifact-IO home) and is
+# re-exported above for the long-standing callers of this module; it now
+# also surfaces the prune-provenance sidecar (see utils/io.load_scores_npz).
 
 
 def scores_npz_path(checkpoint_dir: str) -> str:
@@ -1296,6 +1282,14 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         # cfg's score.method — record where they came from instead.
         loaded_from = score_t.get("loaded_from")
         method = f"reused:{loaded_from}" if loaded_from else cfg.score.method
+        # Provenance manifest built on EVERY rank (deterministic host math —
+        # identical everywhere, and each rank's flight recorder gets the
+        # prune_decision record below even though only rank 0 writes files).
+        manifest = build_prune_manifest(
+            scores, train_ds.indices, kept, method=method,
+            sparsity=float(sparsity), keep=cfg.prune.keep,
+            class_balance=cfg.prune.class_balance, seed=cfg.train.seed,
+            fingerprint=pipeline_fingerprint(cfg))
         if is_primary():   # every process holds the full scores; one writes
             # Atomic (temp + rename): a crash mid-write must never leave a
             # truncated npz that a later score.scores_npz reuse trusts.
@@ -1303,6 +1297,17 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                          indices=train_ds.indices, kept=kept,
                          keep=cfg.prune.keep,
                          class_balance=cfg.prune.class_balance, method=method)
+            # Sidecar AFTER the npz it describes: a crash between the two
+            # leaves an npz without provenance (the warn-once reuse path),
+            # never a manifest describing scores that don't exist.
+            write_prune_manifest(scores_npz_path(ckpt_dir), manifest)
+        logger.log("prune_decision",
+                   manifest=provenance_path(scores_npz_path(ckpt_dir)),
+                   **{k: manifest[k] for k in
+                      ("fingerprint", "method", "sparsity", "keep",
+                       "class_balance", "n_total", "n_kept", "n_dropped",
+                       "nonfinite_scores", "threshold_score", "kept_digest",
+                       "dropped_digest", "top_k", "bottom_k")})
         score_s, pretrain_s = score_t["score_s"], score_t["pretrain_s"]
         prune_rec = dict(n_total=len(train_ds), n_kept=len(kept),
                          score_s=round(score_s, 3),
@@ -1328,6 +1333,13 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         logger.stage(stage, "resuming", ckpt_dir=ckpt_dir)
     if stages is not None:
         stages.start(stage, ckpt_dir=ckpt_dir)
+    # Prune-decision audit at the hand-off: the subset the retrain is about
+    # to train on must be EXACTLY the set the durable sidecar records
+    # (mismatch = loud ValueError, never a silently unauditable model).
+    # Rank 0 verifies — it wrote the sidecar synchronously above; peers may
+    # reach this line before a shared-filesystem write is visible to them.
+    if is_primary():
+        verify_prune_manifest(scores_npz_path(ckpt_dir), kept)
     with _stage_span(stage):
         res = fit_with_recovery(cfg_retrain, train_ds.subset(kept), test_ds,
                                 mesh=mesh, sharder=sharder, logger=logger,

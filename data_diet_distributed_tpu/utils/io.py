@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
 
 import numpy as np
 
@@ -20,6 +21,124 @@ def atomic_savez(path: str, **arrays) -> None:
     tmp = f"{path}.tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """One JSON document written atomically (temp + rename), non-finite
+    floats nulled — the same strict-JSON contract as the JSONL ledger, for
+    sidecar artifacts a reader must never see half-written."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(_finite(obj), fh, indent=1, sort_keys=False)
+    os.replace(tmp, path)
+
+
+def provenance_path(npz_path: str) -> str:
+    """The prune-decision provenance sidecar's path convention: a JSON
+    manifest NEXT TO the scores npz (writer: ``pruning.write_prune_manifest``
+    via the prune stage; readers: ``load_scores_npz``,
+    ``train/loop`` retrain verification, ``tools/score_report.py``)."""
+    return f"{npz_path}.provenance.json"
+
+
+def read_prune_manifest(npz_path: str) -> dict | None:
+    """The provenance sidecar for a scores npz, or None when the artifact
+    predates the Score Observatory (no sidecar) — old artifacts stay
+    loadable. A CORRUPT sidecar raises (a half-written manifest cannot
+    happen through the atomic writer, so corruption means real damage the
+    audit must not paper over)."""
+    path = provenance_path(npz_path)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        try:
+            return json.load(fh)
+        except json.JSONDecodeError as err:
+            raise ValueError(
+                f"{path}: corrupt prune-provenance sidecar ({err}) — delete "
+                "it (the npz stays loadable without provenance) or restore "
+                "it from the run that wrote the scores") from err
+
+
+#: Paths already warned about (once per process): a scores artifact without
+#: a provenance sidecar is legal — pre-observatory artifacts, score-only
+#: runs that never pruned — but worth one mention, not one per reuse.
+_WARNED_NO_PROVENANCE: set[str] = set()
+
+
+def load_scores_npz(path: str, train_ds, expect_method: str | None = None,
+                    return_provenance: bool = False):
+    """Scores from a saved artifact, re-joined to ``train_ds`` row order by
+    GLOBAL index (the artifact may cover a superset or a different ordering
+    of the dataset; any dataset example missing from the artifact refuses
+    loudly via the position joiner's KeyError).
+
+    A truncated or corrupt file (a crash mid-write predating the atomic
+    writers, flaky storage) raises a ``ValueError`` NAMING THE PATH instead
+    of an opaque zip/zlib deserialization error. ``expect_method``: refuse an
+    artifact whose recorded scoring method differs — reusing EL2N scores for
+    a GraNd experiment would silently mix scoring methods. Artifacts without
+    a recorded method (pre-provenance) and ``reused:``-provenance records
+    (already reused once — the original method is unrecoverable) load
+    unchecked.
+
+    The prune-decision provenance sidecar (``provenance_path``) is surfaced
+    when present: ``return_provenance=True`` returns ``(scores, manifest)``
+    (manifest None when absent); either way an artifact WITHOUT a sidecar
+    warns once per path — it stays loadable, but prune decisions derived
+    from it cannot be audited back to the examples they dropped."""
+    import zipfile
+    import zlib
+
+    from ..data.datasets import make_position_joiner
+
+    try:
+        with np.load(path, allow_pickle=False) as d:
+            present = set(d.files)
+            scores = (np.asarray(d["scores"]) if "scores" in present else None)
+            indices = (np.asarray(d["indices"]) if "indices" in present
+                       else None)
+            method = str(d["method"]) if "method" in present else None
+    except FileNotFoundError:
+        raise
+    except (OSError, EOFError, ValueError, zipfile.BadZipFile,
+            zlib.error) as err:
+        raise ValueError(
+            f"{path}: truncated or corrupt scores artifact ({err!r}) — "
+            "recompute the scores (unset score.scores_npz) or point at an "
+            "intact artifact") from err
+    if scores is None or indices is None:
+        raise ValueError(
+            f"{path} is not a scores artifact (needs 'scores' and "
+            "'indices' arrays, as written by the run/score/sweep commands)")
+    if scores.shape != indices.shape:
+        raise ValueError(
+            f"{path}: scores shape {scores.shape} does not match indices "
+            f"shape {indices.shape} — truncated or malformed artifact")
+    if (expect_method is not None and method is not None
+            and not method.startswith("reused:") and method != expect_method):
+        raise ValueError(
+            f"{path} holds {method!r} scores but this run is configured for "
+            f"score.method={expect_method!r} — reusing them would silently "
+            f"mix scoring methods; set score.method={method} or recompute")
+    manifest = read_prune_manifest(path)
+    if manifest is None and path not in _WARNED_NO_PROVENANCE:
+        _WARNED_NO_PROVENANCE.add(path)
+        warnings.warn(
+            f"{path}: no prune-decision provenance sidecar "
+            f"({os.path.basename(provenance_path(path))}) — the artifact "
+            "loads fine, but a prune decision made from it cannot be "
+            "audited back to the examples it kept/dropped (sidecars are "
+            "written by the prune stage since the Score Observatory)",
+            stacklevel=2)
+    pos = make_position_joiner(indices)(train_ds.indices)
+    joined = scores[pos].astype(np.float32)
+    if return_provenance:
+        return joined, manifest
+    return joined
 
 
 def atomic_append_jsonl(path: str, record: dict) -> None:

@@ -220,3 +220,27 @@ def test_bench_northstar_smoke():
     # (value is rounded to 4 decimals, so compare with relative tolerance).
     budget = 60.0 * 128 * 2 / (50_000 * 10)
     assert abs(line["vs_baseline"] - budget / line["value"])         <= 0.05 * line["vs_baseline"] + 1e-6
+
+
+def test_bench_score_embeds_score_quality_block():
+    """--task score rides the per-seed score_stats summary and (seeds >= 2)
+    the cross-seed stability block in its BENCH JSON, so perf_sentry can
+    track score quality next to throughput without a schema change."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--no-ledger", "--size",
+         "128", "--batch", "64", "--arch", "tiny_cnn", "--method", "el2n",
+         "--seeds", "2", "--repeats", "1", "--chunk", "4", "--no-probe"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "el2n_scoring_examples_per_sec_per_chip"
+    assert line["value"] > 0
+    stats = line["score_stats"]
+    assert [s["seed"] for s in stats] == [0, 1]
+    for s in stats:
+        assert s["mean"] is not None and s["nonfinite"] == 0
+    stab = line["score_stability"]
+    assert stab["n_seeds"] == 2
+    assert -1.0 <= stab["spearman_pairwise_mean"] <= 1.0
+    assert "0.5" in stab["overlap_at_keep"]

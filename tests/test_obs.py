@@ -283,3 +283,62 @@ def test_plot_scores_class_balanced_skips_global_cut(tmp_path):
              class_balance=True)
     out = plot_scores(npz, str(tmp_path / "plots"), name="cb.png")
     assert [os.path.basename(p) for p in out] == ["cb.png"]
+
+
+def test_score_hist_series_exact_bins(tmp_path):
+    """The score-stats histogram data the chart draws, pinned EXACTLY: a
+    Scoreboard's record reproduces np.histogram over a synthetic
+    distribution bit-for-bit, and score_hist_series hands those bins to the
+    renderer unmodified (latest record per (method, seed) wins)."""
+    import numpy as np
+    from data_diet_distributed_tpu.obs import scoreboard
+    from data_diet_distributed_tpu.obs.plots import score_hist_series
+
+    rng = np.random.default_rng(7)
+    scores = np.concatenate([rng.normal(0, 1, 400), rng.normal(5, 0.3, 100)])
+    mpath = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    board = scoreboard.Scoreboard(logger=logger, bins=16)
+    board.note_seed_scores("el2n", 0, scores)
+    board.note_seed_scores("el2n", 0, scores * 2.0)   # newer record wins
+    logger.close()
+    records = [json.loads(l) for l in open(mpath) if l.strip()]
+    series = score_hist_series(records)
+    assert set(series) == {"el2n"}
+    (seed, edges, counts), = series["el2n"]
+    want_counts, want_edges = np.histogram(scores * 2.0, bins=16)
+    assert seed == 0
+    assert counts == want_counts.tolist()
+    assert edges == [float(e) for e in want_edges]
+    assert sum(counts) == len(scores)
+    # Records without a histogram (all-NaN vector) are skipped, not drawn.
+    board2 = scoreboard.Scoreboard(logger=None)
+    rec = board2.note_seed_scores("x", 1, np.full(8, np.nan))
+    assert rec["hist"] is None
+    assert score_hist_series(
+        [{"kind": "score_stats", "method": "x", "seed": 1, "hist": None}]) == {}
+
+
+@requires_mpl
+def test_plot_score_stats_agg_smoke(tmp_path):
+    """Agg smoke for the per-seed score-distribution renderer: one non-empty
+    PNG per method from a stream with two methods x two seeds."""
+    import numpy as np
+    from data_diet_distributed_tpu.obs import plot_score_stats, scoreboard
+
+    rng = np.random.default_rng(8)
+    mpath = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    board = scoreboard.Scoreboard(logger=logger, bins=12)
+    for method in ("el2n", "grand"):
+        for seed in (0, 1):
+            board.note_seed_scores(method, seed, rng.random(200))
+    logger.close()
+    out = plot_score_stats(mpath, str(tmp_path / "plots"))
+    assert sorted(os.path.basename(p) for p in out) == [
+        "score_stats_el2n.png", "score_stats_grand.png"]
+    for p in out:
+        assert os.path.getsize(p) > 0
+    # Missing stream / no score_stats records degrade to no-op.
+    assert plot_score_stats(str(tmp_path / "missing.jsonl"),
+                            str(tmp_path)) == []

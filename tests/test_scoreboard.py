@@ -1,0 +1,402 @@
+"""Score Observatory (obs/scoreboard.py + prune provenance): the stats math
+pinned exactly, the no-op-until-installed contract, the provenance manifest
+round trip + the retrain-stage audit, and the acceptance run — a 2-seed,
+2-method (el2n + grand) CPU pipeline whose score_stats / score_stability /
+prune_decision records validate, whose manifest round-trips through
+load_scores_npz and is verified by the retrain stage, and whose
+tools/score_report.py rendering shows the cross-seed Spearman/overlap@k
+matrix."""
+
+import importlib.util
+import json
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu import pruning
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import (MetricsLogger, MetricsRegistry,
+                                           emit_run_summary, scoreboard)
+from data_diet_distributed_tpu.obs import registry as obs_registry
+from data_diet_distributed_tpu.utils.io import (load_scores_npz,
+                                                provenance_path,
+                                                read_prune_manifest)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ stats math
+
+
+def test_score_stats_exact():
+    rng = np.random.default_rng(0)
+    scores = rng.normal(2.0, 0.5, 1000)
+    st = scoreboard.score_stats(scores, bins=16)
+    assert st["n"] == 1000
+    assert st["nan_count"] == 0 and st["inf_count"] == 0
+    assert st["mean"] == pytest.approx(float(scores.mean()))
+    assert st["std"] == pytest.approx(float(scores.std()))
+    for q, key in ((5, "p5"), (50, "p50"), (95, "p95")):
+        assert st[key] == pytest.approx(float(np.percentile(scores, q)))
+    counts, edges = np.histogram(scores, bins=16)
+    assert st["hist"]["counts"] == counts.tolist()
+    assert st["hist"]["edges"] == [float(e) for e in edges]
+    assert sum(st["hist"]["counts"]) == 1000   # bounded AND complete
+
+
+def test_score_stats_nonfinite_counted_not_poisoning():
+    scores = np.array([1.0, 2.0, np.nan, np.inf, -np.inf, 3.0])
+    st = scoreboard.score_stats(scores)
+    assert st["nan_count"] == 1 and st["inf_count"] == 2
+    assert st["mean"] == pytest.approx(2.0)     # finite values only
+    assert st["max"] == 3.0 and st["min"] == 1.0
+    # All-non-finite degrades to null stats, never raises.
+    st = scoreboard.score_stats(np.full(4, np.nan))
+    assert st["mean"] is None and st["hist"] is None and st["nan_count"] == 4
+
+
+def test_top_k_matches_pruning_keep_hardest():
+    """overlap@k must measure the set a keep-hardest prune would keep: same
+    (score desc, id asc) tie-break as pruning._choose."""
+    rng = np.random.default_rng(1)
+    scores = np.round(rng.random(64), 1)   # plenty of ties
+    indices = np.arange(64)
+    kept = pruning.select_indices(scores, indices, sparsity=0.5)
+    top = np.sort(scoreboard.top_k_positions(scores, 32))
+    assert np.array_equal(top, kept)
+
+
+def test_rank_stability_exact_agreement_and_reversal():
+    rng = np.random.default_rng(2)
+    a = rng.random(100)
+    stab = scoreboard.rank_stability({0: a, 1: a.copy()}, (0.5,))
+    assert stab["n_seeds"] == 2 and stab["n"] == 100
+    assert stab["spearman_pairwise_mean"] == pytest.approx(1.0)
+    assert stab["spearman_pairwise"][0][1] == pytest.approx(1.0)
+    assert stab["overlap_at_keep"]["0.5"] == pytest.approx(1.0)
+    assert stab["spearman_vs_mean_mean"] == pytest.approx(1.0)
+    # Perfect anti-correlation: ρ=-1 and the top halves are disjoint.
+    stab = scoreboard.rank_stability({0: a, 1: -a}, (0.5,))
+    assert stab["spearman_pairwise_mean"] == pytest.approx(-1.0)
+    assert stab["overlap_at_keep"]["0.5"] == pytest.approx(0.0)
+
+
+def test_rank_stability_needs_two_seeds():
+    assert scoreboard.rank_stability({0: np.arange(10.0)}, (0.5,)) is None
+    assert scoreboard.rank_stability({}, (0.5,)) is None
+
+
+def test_scoreboard_records_gauges_and_retention_cap(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path, echo=False)
+    obs_registry.install(MetricsRegistry())
+    try:
+        board = scoreboard.Scoreboard(logger=logger, bins=8, max_seeds=2)
+        rng = np.random.default_rng(3)
+        for s in range(3):   # one past the retention cap
+            board.note_seed_scores("el2n", s, rng.random(50))
+        stab = board.note_stability("el2n", keep_fractions=(0.5, 0.25))
+        logger.close()
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        stats = [r for r in recs if r["kind"] == "score_stats"]
+        assert [r["seed"] for r in stats] == [0, 1, 2]
+        assert all(r["method"] == "el2n" and r["n"] == 50 for r in stats)
+        stab_recs = [r for r in recs if r["kind"] == "score_stability"]
+        assert len(stab_recs) == 1
+        # Seed 2 fell past the cap: excluded AND named, never silent.
+        assert stab["n_seeds"] == 2 and stab["dropped_seeds"] == [2]
+        assert set(stab["overlap_at_keep"]) == {"0.5", "0.25"}
+        gauges = obs_registry.current().snapshot()["gauges"]
+        assert "score_mean:el2n" in gauges
+        assert "score_stability_rho:el2n" in gauges
+        assert "score_overlap:el2n:0.5" in gauges
+        # ...and the Prometheus export sanitizes the ':' names.
+        assert "ddt_score_mean_el2n" in obs_registry.current().to_prometheus()
+    finally:
+        obs_registry.uninstall()
+
+
+def test_module_helpers_noop_until_installed():
+    scoreboard.uninstall()
+    scoreboard.note_seed_scores("el2n", 0, np.arange(4.0))   # must not raise
+    scoreboard.note_stability("el2n")
+    assert scoreboard.summary() == {}
+    assert scoreboard.current() is None
+
+
+# ------------------------------------------------- provenance manifest
+
+
+def _manifest_fixture():
+    rng = np.random.default_rng(4)
+    scores = rng.random(40).astype(np.float32)
+    indices = np.arange(100, 140)   # non-trivial global-id space
+    kept = pruning.select_indices(scores, indices, sparsity=0.5)
+    manifest = pruning.build_prune_manifest(
+        scores, indices, kept, method="el2n", sparsity=0.5, keep="hardest",
+        seed=0, fingerprint="abc123")
+    return scores, indices, kept, manifest
+
+
+def test_build_prune_manifest_fields():
+    scores, indices, kept, m = _manifest_fixture()
+    assert m["n_total"] == 40 and m["n_kept"] == 20 and m["n_dropped"] == 20
+    assert m["kept_digest"] == pruning.index_digest(kept)
+    assert m["dropped_digest"] == pruning.index_digest(
+        np.setdiff1d(indices, kept))
+    # Threshold = min kept score for keep-hardest.
+    kept_mask = np.isin(indices, kept)
+    assert m["threshold_score"] == pytest.approx(float(scores[kept_mask].min()))
+    # top_k is (score desc, id asc) and within the kept set.
+    top_scores = [e["score"] for e in m["top_k"]]
+    assert top_scores == sorted(top_scores, reverse=True)
+    assert all(e["index"] in set(kept.tolist()) for e in m["top_k"])
+    bottom_scores = [e["score"] for e in m["bottom_k"]]
+    assert bottom_scores == sorted(bottom_scores)
+    assert m["fingerprint"] == "abc123" and m["nonfinite_scores"] == 0
+
+
+def test_manifest_extremes_exclude_nonfinite_and_stay_strict_json():
+    """NaN-scored examples are neither hardest nor easiest: they fall off
+    BOTH extreme lists (counted in nonfinite_scores instead), and the
+    manifest — which also rides the prune_decision JSONL record verbatim —
+    never carries a bare NaN token."""
+    scores = np.array([0.1, np.nan, 0.9, np.inf, 0.5, 0.3])
+    indices = np.arange(6)
+    kept = np.array([0, 2, 4])
+    m = pruning.build_prune_manifest(scores, indices, kept, method="el2n",
+                                     sparsity=0.5, keep="random",
+                                     extremes_k=10)
+    assert m["nonfinite_scores"] == 2
+    assert [e["index"] for e in m["top_k"]] == [2, 4, 5, 0]
+    assert [e["index"] for e in m["bottom_k"]] == [0, 5, 4, 2]
+    text = json.dumps(m)   # strict JSON: would embed NaN/Infinity otherwise
+    assert "NaN" not in text and "Infinity" not in text
+
+
+def test_manifest_write_verify_roundtrip(tmp_path):
+    scores, indices, kept, m = _manifest_fixture()
+    npz = str(tmp_path / "x_scores.npz")
+    np.savez(npz, scores=scores, indices=indices, kept=kept, method="el2n")
+    path = pruning.write_prune_manifest(npz, m)
+    assert path == provenance_path(npz)
+    assert pruning.verify_prune_manifest(npz, kept)["kept_digest"] == \
+        m["kept_digest"]
+    # digest is order-independent (the retrain is handed a SORTED subset,
+    # but the audit must not depend on it)
+    assert pruning.verify_prune_manifest(npz, kept[::-1])
+    # Mismatched subset = loud error naming both digests.
+    with pytest.raises(ValueError, match="provenance mismatch"):
+        pruning.verify_prune_manifest(npz, kept[:-1])
+    wrong = kept.copy()
+    wrong[0] = 999
+    with pytest.raises(ValueError, match="provenance mismatch"):
+        pruning.verify_prune_manifest(npz, wrong)
+
+
+def test_load_scores_npz_surfaces_provenance(tmp_path):
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    train_ds, _ = load_dataset("synthetic", synthetic_size=40, seed=0)
+    scores = np.linspace(0, 1, 40).astype(np.float32)
+    npz = str(tmp_path / "y_scores.npz")
+    np.savez(npz, scores=scores, indices=train_ds.indices)
+    # No sidecar: loadable, warns ONCE per path.
+    with pytest.warns(UserWarning, match="no prune-decision provenance"):
+        out = load_scores_npz(npz, train_ds)
+    assert np.array_equal(out, scores)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        load_scores_npz(npz, train_ds)   # second load: silent
+    # With a sidecar: surfaced through return_provenance, no warning.
+    kept = pruning.select_indices(scores, train_ds.indices, 0.5)
+    m = pruning.build_prune_manifest(scores, train_ds.indices, kept,
+                                     method="el2n", sparsity=0.5)
+    pruning.write_prune_manifest(npz, m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out, man = load_scores_npz(npz, train_ds, return_provenance=True)
+    assert man["kept_digest"] == m["kept_digest"]
+    assert read_prune_manifest(npz)["n_kept"] == 20
+    # A corrupt sidecar refuses loudly (atomic writes can't half-write one).
+    with open(provenance_path(npz), "w") as fh:
+        fh.write('{"broken"')
+    with pytest.raises(ValueError, match="corrupt prune-provenance"):
+        read_prune_manifest(npz)
+
+
+def test_retrain_refuses_mismatched_manifest(tmp_path, mesh8, tiny_ds,
+                                             monkeypatch):
+    """The retrain-stage audit end to end: a sidecar that does not describe
+    the subset the retrain is handed aborts the pipeline loudly."""
+    from data_diet_distributed_tpu.train import loop as loop_mod
+
+    def corrupt_write(npz_path, manifest):
+        manifest = dict(manifest, kept_digest="deadbeefdeadbeef")
+        return pruning.write_prune_manifest(npz_path, manifest)
+
+    monkeypatch.setattr(loop_mod, "write_prune_manifest", corrupt_write)
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+        "prune.sparsity=0.5"])
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    with pytest.raises(ValueError, match="provenance mismatch"):
+        loop_mod.run_datadiet(cfg, logger)
+    logger.close()
+
+
+def test_keep_fractions_from_config():
+    from data_diet_distributed_tpu.train.loop import keep_fractions
+    cfg = load_config(None, ["prune.sparsity=0.3"])
+    assert keep_fractions(cfg) == (0.7,)
+    cfg = load_config(None, ["prune.sweep=[0.3,0.5,0.7]"])
+    assert keep_fractions(cfg) == (0.3, 0.5, 0.7)
+    cfg = load_config(None, ["prune.sparsity=0.0"])
+    assert keep_fractions(cfg) == (0.5,)   # score-only default
+
+
+# ------------------------------------------------- acceptance (2x2 CPU run)
+
+
+@pytest.fixture(scope="module")
+def observatory_run(tmp_path_factory):
+    """ONE 2-seed, 2-method (el2n + grand) CPU pipeline shared by the
+    acceptance assertions below: both methods run score→prune→retrain with
+    an installed Scoreboard, into one metrics stream."""
+    from data_diet_distributed_tpu.train import loop as loop_mod
+    td = tmp_path_factory.mktemp("observatory")
+    mpath = str(td / "metrics.jsonl")
+    logger = MetricsLogger(mpath, echo=False)
+    obs_registry.install(MetricsRegistry())
+    scoreboard.install(scoreboard.Scoreboard(logger=logger))
+    try:
+        for method in ("el2n", "grand"):
+            cfg = load_config(None, [
+                "data.dataset=synthetic", "data.synthetic_size=256",
+                "data.batch_size=64", "data.eval_batch_size=64",
+                "model.arch=tiny_cnn", "optim.lr=0.1",
+                "train.num_epochs=1", "train.half_precision=false",
+                "train.log_every_steps=1000", "train.checkpoint_every=1",
+                f"train.checkpoint_dir={td}/ckpt_{method}",
+                f"obs.metrics_path={mpath}",
+                f"score.method={method}", "score.seeds=[0,1]",
+                "score.pretrain_epochs=0", "score.batch_size=64",
+                "prune.sparsity=0.5"])
+            loop_mod.run_datadiet(cfg, logger)
+        emit_run_summary(logger, wall_s=1.0, exit_class="ok", command="run")
+    finally:
+        scoreboard.uninstall()
+        obs_registry.uninstall()
+        logger.close()
+    return td
+
+
+def test_acceptance_records_validate(observatory_run):
+    vm = _load_tool("validate_metrics")
+    problems = vm.validate_file(str(observatory_run / "metrics.jsonl"),
+                                expect_terminal=True)
+    assert problems == [], problems
+    recs = [json.loads(l) for l in open(observatory_run / "metrics.jsonl")
+            if l.strip()]
+    stats = [r for r in recs if r["kind"] == "score_stats"]
+    assert [(r["method"], r["seed"]) for r in stats] == \
+        [("el2n", 0), ("el2n", 1), ("grand", 0), ("grand", 1)]
+    for r in stats:
+        assert r["n"] == 256 and r["nan_count"] == 0
+        assert sum(r["hist"]["counts"]) == 256
+    stab = {r["method"]: r for r in recs if r["kind"] == "score_stability"}
+    assert set(stab) == {"el2n", "grand"}
+    for r in stab.values():
+        assert r["n_seeds"] == 2 and r["seeds"] == [0, 1]
+        assert len(r["spearman_pairwise"]) == 2
+        assert "0.5" in r["overlap_at_keep"]
+    decisions = {r["method"]: r for r in recs if r["kind"] == "prune_decision"}
+    assert set(decisions) == {"el2n", "grand"}
+    for r in decisions.values():
+        assert r["n_kept"] == 128 and len(r["kept_digest"]) == 16
+    # The terminal event surfaces both methods' stability blocks.
+    summary = recs[-1]
+    assert summary["kind"] == "run_summary"
+    assert set(summary["score_stability"]) == {"el2n", "grand"}
+
+
+def test_acceptance_manifest_roundtrip_and_retrain_verified(observatory_run):
+    """The provenance manifest round-trips through load_scores_npz and was
+    verified by the retrain stage (the run completing IS the verification —
+    test_retrain_refuses_mismatched_manifest pins the failure arm)."""
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.train.loop import scores_npz_path
+    train_ds, _ = load_dataset("synthetic", synthetic_size=256, seed=0)
+    for method in ("el2n", "grand"):
+        npz = scores_npz_path(str(observatory_run / f"ckpt_{method}"))
+        scores, man = load_scores_npz(npz, train_ds, expect_method=method,
+                                      return_provenance=True)
+        assert man is not None and man["method"] == method
+        assert man["n_kept"] == 128
+        # The sidecar describes exactly the npz's kept set.
+        with np.load(npz) as d:
+            assert pruning.index_digest(d["kept"]) == man["kept_digest"]
+        assert len(man["top_k"]) == 10 and len(man["bottom_k"]) == 10
+
+
+def test_acceptance_score_report_renders_matrix(observatory_run, capsys):
+    sr = _load_tool("score_report")
+    rc = sr.main([str(observatory_run)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Spearman ρ matrix" in out
+    assert "cross-seed stability [el2n]" in out
+    assert "cross-seed stability [grand]" in out
+    assert "overlap@keep=0.5" in out
+    assert "prune decisions:" in out
+    # Cross-method agreement: both artifacts live in the run dir.
+    assert "keep/drop agreement across artifacts" in out
+    # Machine-readable mode carries the same matrix.
+    rc = sr.main([str(observatory_run), "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["score_stability"]["el2n"]["n_seeds"] == 2
+    assert len(rep["score_stability"]["grand"]["spearman_pairwise"]) == 2
+    assert rep["method_overlap"], "el2n-vs-grand overlap section missing"
+    pair = rep["method_overlap"][0]
+    assert {pair["method_a"], pair["method_b"]} == {"el2n", "grand"}
+    assert -1.0 <= pair["spearman"] <= 1.0
+
+
+def test_score_report_two_run_drift(observatory_run, tmp_path, capsys):
+    """Two runs given → the drift section compares score vectors joined by
+    global index."""
+    sr = _load_tool("score_report")
+    # Second "run": a copy of the el2n artifact with perturbed scores.
+    from data_diet_distributed_tpu.train.loop import scores_npz_path
+    npz = scores_npz_path(str(observatory_run / "ckpt_el2n"))
+    with np.load(npz) as d:
+        scores, indices = d["scores"], d["indices"]
+    rng = np.random.default_rng(0)
+    (tmp_path / "runb").mkdir()
+    np.savez(str(tmp_path / "runb" / "b_scores.npz"),
+             scores=scores + 0.01 * rng.random(len(scores)).astype(np.float32),
+             indices=indices, method="el2n")
+    rc = sr.main([str(observatory_run), "--b", str(tmp_path / "runb"),
+                  "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["drift"], "drift section missing"
+    drifts = [p["spearman"] for p in rep["drift"]
+              if p["method_a"] == "el2n" and p["method_b"] == "el2n"]
+    assert drifts and all(d > 0.9 for d in drifts)

@@ -131,6 +131,98 @@ def test_fault_stream_validates(vm, tmp_path, mesh8, tiny_ds):
     assert {"fault", "recovery"} <= kinds
 
 
+def test_score_observatory_kinds_validate(vm):
+    """score_stats / score_stability / prune_decision: required fields
+    enforced, null-tolerant values accepted (an all-NaN vector nulls mean)."""
+    ok = [
+        json.dumps({"ts": 1.0, "kind": "score_stats", "method": "el2n",
+                    "seed": 0, "n": 256, "mean": None, "std": None,
+                    "nan_count": 256}),
+        json.dumps({"ts": 2.0, "kind": "score_stability", "method": "el2n",
+                    "n_seeds": 2, "spearman_pairwise_mean": 0.97,
+                    "overlap_at_keep": {"0.5": 0.9}}),
+        json.dumps({"ts": 3.0, "kind": "prune_decision", "method": "el2n",
+                    "sparsity": 0.5, "n_total": 256, "n_kept": 128,
+                    "kept_digest": "abc", "manifest": "x.provenance.json"}),
+    ]
+    assert vm.validate_lines(ok) == []
+    bad = [json.dumps({"ts": 1.0, "kind": "score_stats", "method": "el2n"}),
+           json.dumps({"ts": 2.0, "kind": "prune_decision", "method": "x"})]
+    text = "\n".join(vm.validate_lines(bad, where="s"))
+    assert "kind 'score_stats' missing required field 'seed'" in text
+    assert "kind 'prune_decision' missing required field 'kept_digest'" in text
+
+
+def test_two_seed_run_stream_validates(vm, tmp_path, mesh8, tiny_ds):
+    """The acceptance lane's real 2-seed CPU run, through the validator: the
+    Observatory kinds the pipeline emits satisfy their own schema."""
+    from data_diet_distributed_tpu.obs import scoreboard
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.seeds=[0,1]", "score.pretrain_epochs=0",
+        "score.batch_size=64", "prune.sparsity=0.5"])
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    scoreboard.install(scoreboard.Scoreboard(logger=logger))
+    try:
+        loop_mod.run_datadiet(cfg, logger)
+        emit_run_summary(logger, wall_s=1.0, exit_class="ok", command="run")
+    finally:
+        scoreboard.uninstall()
+        logger.close()
+    problems = vm.validate_file(str(tmp_path / "metrics.jsonl"),
+                                expect_terminal=True)
+    assert problems == [], problems
+    kinds = {json.loads(l)["kind"]
+             for l in open(tmp_path / "metrics.jsonl") if l.strip()}
+    assert {"score_stats", "score_stability", "prune_decision"} <= kinds
+
+
+EMITTED_KIND_PATTERNS = (
+    # logger.log("kind", ...) — any receiver name (logger/self/obs_logger).
+    r'\.log\(\s*"([a-z_][a-z0-9_]*)"',
+    # Ledger/JSONL record literals: {"kind": "...", "ts": ...} — the ts on
+    # the same line is what separates a STREAM record from the unrelated
+    # "kind" vocabularies (grand_batched layer descriptors, bench conv
+    # probes), which never carry a timestamp.
+    r'\{"kind":\s*"([a-z_][a-z0-9_]*)",\s*"ts"',
+)
+
+
+def test_every_emitted_kind_has_a_registered_validator(vm):
+    """The lint that keeps the schema honest: every record kind the package
+    emits (grep over the source for logger.log literals and ledger record
+    literals) must be in the validator's KNOWN_KINDS table — a new kind can
+    never ship unvalidated again. (f-string kinds like
+    f"{method}_seed_done" are unmatched by design; both expansions are
+    pinned in KNOWN_KINDS and exercised by the forgetting/aum tests.)"""
+    import re
+    sources = sorted((REPO / "data_diet_distributed_tpu").rglob("*.py"))
+    sources += [REPO / "bench.py"]
+    sources += sorted((REPO / "tools").glob("*.py"))
+    emitted: dict[str, list[str]] = {}
+    for path in sources:
+        text = path.read_text()
+        for pat in EMITTED_KIND_PATTERNS:
+            for m in re.finditer(pat, text):
+                emitted.setdefault(m.group(1), []).append(
+                    str(path.relative_to(REPO)))
+    assert emitted, "the grep found no emitted kinds — pattern rot"
+    # Sanity: the grep really sees the core emitters.
+    assert "epoch" in emitted and "perf_history" in emitted
+    assert "score_stats" in emitted and "prune_decision" in emitted
+    unregistered = {k: sorted(set(v)) for k, v in emitted.items()
+                    if k not in vm.KNOWN_KINDS}
+    assert not unregistered, (
+        f"emitted kinds without a registered validator in "
+        f"tools/validate_metrics.py KNOWN_KINDS: {unregistered}")
+
+
 def test_cli_entrypoint_exit_codes(vm, tmp_path):
     import subprocess
     import sys

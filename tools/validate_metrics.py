@@ -46,6 +46,9 @@ KNOWN_KINDS = frozenset({
     # XLA/device introspection (obs/xla.py) + the perf-history ledger
     # (tools/perf_sentry.py reads streams of the latter)
     "xla_program", "hbm_watermark", "perf_history",
+    # Score Observatory (obs/scoreboard.py + pruning provenance): per-seed
+    # score distributions, cross-seed rank stability, prune decisions.
+    "score_stats", "score_stability", "prune_decision",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -64,6 +67,15 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "xla_program": ("program", "compile_s", "flops"),
     "hbm_watermark": ("device", "bytes_in_use", "peak_bytes"),
     "perf_history": ("source", "metric", "value", "unit"),
+    # Score Observatory records. Null-tolerant like xla_program: an
+    # all-NaN score vector degrades mean/std to null, a degenerate
+    # stability pass degrades ρ to null — the KEYS must be present so
+    # consumers can rely on the shape.
+    "score_stats": ("method", "seed", "n", "mean", "std", "nan_count"),
+    "score_stability": ("method", "n_seeds", "spearman_pairwise_mean",
+                        "overlap_at_keep"),
+    "prune_decision": ("method", "sparsity", "n_total", "n_kept",
+                       "kept_digest", "manifest"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
