@@ -85,11 +85,58 @@ DEFAULT_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "artifacts", "perf_history.jsonl")
 
 
+#: Bench SLO: fractional drop vs the trailing ledger baseline past which the
+#: embedded verdict reads "violated" (same default as tools/perf_sentry.py).
+SLO_THRESHOLD = 0.10
+
+
+def _slo_verdict(metric: str, value: float, unit: str) -> dict | None:
+    """Final SLO verdict for a MEASURED line: this value vs the trailing
+    median of clean ledger records of the same (metric, geometry) shape —
+    the perf sentry's comparison, computed at capture time so the BENCH JSON
+    (and the ledger record perf_sentry later reads) carries health next to
+    throughput. None without a ledger; "no-baseline" without clean history."""
+    if not _LEDGER["path"]:
+        return None
+    try:
+        from data_diet_distributed_tpu.obs.slo import ledger_baseline
+        backend = None
+        if "jax" in sys.modules:   # measurement lines always have a backend
+            import jax
+            backend = jax.default_backend()
+        baseline = ledger_baseline(_LEDGER["path"], field="value",
+                                   metric=metric, backend=backend,
+                                   geometry=_LEDGER["geometry"])
+        if baseline is None:
+            return {"verdict": "no-baseline"}
+        delta = (value - baseline) / baseline
+        if unit in ("seconds", "s"):
+            delta = -delta   # lower-better: normalize so positive = better
+        return {"verdict": "violated" if delta < -SLO_THRESHOLD else "ok",
+                "baseline": round(baseline, 2), "delta_frac": round(delta, 4),
+                "threshold": SLO_THRESHOLD}
+    except Exception as exc:   # noqa: BLE001 — the verdict must not mask the number
+        print(f"[bench] slo verdict failed: {exc!r}", file=sys.stderr,
+              flush=True)
+        return None
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float, **extra) -> None:
     line = {"metric": metric, "value": value, "unit": unit,
             "vs_baseline": vs_baseline}
     line.update(_CAPTURE_DIAGNOSTICS)
     line.update(extra)
+    if "error" not in line and value > 0:
+        slo = _slo_verdict(metric, value, unit)
+        if slo is not None:
+            line.setdefault("slo", slo)
+    # --serve-port: serving-cost accounting rides every line, so the
+    # overhead claim ("server ≈ free") is measured, not asserted. The module
+    # is only consulted when already imported — error lines can precede any
+    # obs import.
+    srv_mod = sys.modules.get("data_diet_distributed_tpu.obs.server")
+    if srv_mod is not None and srv_mod.current() is not None:
+        line.setdefault("serve", srv_mod.current().stats())
     print(json.dumps(line), flush=True)
     _append_ledger(line)
 
@@ -105,7 +152,7 @@ def _append_ledger(line: dict) -> None:
                "source": "bench", "geometry": _LEDGER["geometry"]}
         for k in ("metric", "value", "unit", "vs_baseline", "error",
                   "exit_class", "chunk_steps", "mfu", "pass_s",
-                  "score_stability"):
+                  "score_stability", "slo", "serve"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -275,6 +322,13 @@ def main() -> None:
     parser.add_argument("--prom-path", default=None,
                         help="also write the registry's Prometheus textfile "
                              "(MFU/flops/compile-time/HBM gauges) here")
+    parser.add_argument("--serve-port", type=int, default=None,
+                        help="serve the live obs endpoints (/healthz "
+                             "/metrics /status /flightrec) for the duration "
+                             "of the timed task (0 = auto-pick). The server "
+                             "runs on a daemon thread outside the timed "
+                             "region; its measured cost (requests, handle "
+                             "wall) is embedded in the JSON as \"serve\"")
     args = parser.parse_args()
     if args.seeds is None:
         # Task-aware default: the northstar workload IS 10 scoring models;
@@ -372,6 +426,12 @@ def main() -> None:
             prom_path=args.prom_path if args.process_id == 0 else None))
         obs_xla.install(obs_xla.XlaIntrospector(logger=obs_logger),
                         obs_xla.HbmMonitor(logger=obs_logger))
+        srv = None
+        if args.serve_port is not None:
+            from data_diet_distributed_tpu.obs import server as obs_server
+            srv = obs_server.install(obs_server.StatusServer(
+                port=args.serve_port, logger=obs_logger))
+            srv.start()   # bind failure degrades to a no-op with one warning
         try:
             with guard:
                 if args.task == "train":
@@ -393,6 +453,11 @@ def main() -> None:
             finally:
                 # Module-global slots must not outlive the bench (tests call
                 # main() in-process; a leaked registry would instrument them).
+                if srv is not None:
+                    from data_diet_distributed_tpu.obs import \
+                        server as obs_server
+                    srv.stop()
+                    obs_server.uninstall()
                 obs_xla.uninstall()
                 obs_registry.uninstall()
     except WatchdogTimeout as exc:

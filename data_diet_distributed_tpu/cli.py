@@ -204,6 +204,12 @@ def _append_perf_ledger(cfg: Config, command: str, summary: dict) -> None:
                 rec[k] = final[k]
         if "mfu" in summary:
             rec["mfu"] = summary["mfu"]
+        if isinstance(summary.get("slo"), dict):
+            # Health next to throughput in the trail (mirrors bench.py's
+            # embedded verdict): a run that met its floors says so in the
+            # same record the sentry reads.
+            rec["slo"] = {"ok": summary["slo"].get("ok"),
+                          "violations": summary["slo"].get("violations")}
         atomic_append_jsonl(cfg.obs.perf_ledger, rec)
     except Exception as exc:   # noqa: BLE001 — ledger is observability, not outcome
         print(f"[obs] perf ledger append failed: {exc!r}", file=sys.stderr,

@@ -344,6 +344,36 @@ class ObsConfig:
     # record at exit. None = off (bench.py keeps its own default ON — the
     # bench IS the official perf record).
     perf_ledger: str | None = None
+    # Embedded status/health HTTP server (obs/server.py): /healthz /metrics
+    # /status /flightrec served live from a daemon thread. None = off;
+    # 0 = auto-pick a free port (chosen port logged as an obs_server event
+    # and written into run_summary); a bind failure degrades to a no-op
+    # with one warning — never crashes a run. Under multi-host, every rank
+    # serves its own endpoints (use 0 when ranks share a host).
+    server_port: int | None = None
+    server_host: str = "127.0.0.1"
+    # Cross-rank fleet view (obs/fleet.py): {"kind": "fleet_status"} records
+    # merging per-rank heartbeats (step-lag + straggler naming) at epoch
+    # boundaries, plus an independent watch thread under multi-host that
+    # emits on straggler transitions even while the training thread is
+    # wedged. Needs heartbeats; silent on single-rank runs.
+    fleet: bool = True
+    # SLO engine (obs/slo.py): objectives evaluated at epoch/scoring
+    # boundaries -> {"kind": "slo_violation"} records (flight-recorder
+    # mirrored), slo_* gauges, and the /healthz verdict. All None = engine
+    # off. Throughput floors apply to steady epochs only: absolute ex/s,
+    # and/or a fraction of the trailing perf-ledger baseline (clean records
+    # only, the perf-sentry discipline — needs obs.perf_ledger).
+    slo_throughput_floor: float | None = None
+    slo_throughput_frac: float | None = None
+    # Heartbeat staleness budget (seconds): the /healthz degraded threshold
+    # and the epoch-boundary slo_violation check. None = the server's
+    # default budget (obs/server.DEFAULT_STALE_S) for /healthz, no SLO.
+    slo_heartbeat_stale_s: float | None = None
+    # Max tolerated fraction of NaN/inf entries in a scoring pass's output.
+    slo_nonfinite_frac: float | None = None
+    # Eval-accuracy floor checked at each eval boundary.
+    slo_eval_accuracy_floor: float | None = None
 
 
 @dataclass
@@ -463,6 +493,34 @@ class Config:
         if o.score_hist_bins < 1:
             raise ValueError(
                 f"obs.score_hist_bins must be >= 1, got {o.score_hist_bins}")
+        if o.server_port is not None and not 0 <= o.server_port <= 65535:
+            raise ValueError(
+                f"obs.server_port must be in [0, 65535] (0 = auto-pick, "
+                f"null = off), got {o.server_port}")
+        if o.slo_throughput_floor is not None and o.slo_throughput_floor <= 0:
+            raise ValueError(
+                f"obs.slo_throughput_floor must be > 0, got "
+                f"{o.slo_throughput_floor}")
+        if (o.slo_throughput_frac is not None
+                and not 0.0 < o.slo_throughput_frac <= 1.0):
+            raise ValueError(
+                f"obs.slo_throughput_frac must be in (0, 1], got "
+                f"{o.slo_throughput_frac}")
+        if (o.slo_heartbeat_stale_s is not None
+                and o.slo_heartbeat_stale_s <= 0):
+            raise ValueError(
+                f"obs.slo_heartbeat_stale_s must be > 0, got "
+                f"{o.slo_heartbeat_stale_s}")
+        if (o.slo_nonfinite_frac is not None
+                and not 0.0 <= o.slo_nonfinite_frac < 1.0):
+            raise ValueError(
+                f"obs.slo_nonfinite_frac must be in [0, 1), got "
+                f"{o.slo_nonfinite_frac}")
+        if (o.slo_eval_accuracy_floor is not None
+                and not 0.0 <= o.slo_eval_accuracy_floor <= 1.0):
+            raise ValueError(
+                f"obs.slo_eval_accuracy_floor must be in [0, 1], got "
+                f"{o.slo_eval_accuracy_floor}")
         return self
 
 

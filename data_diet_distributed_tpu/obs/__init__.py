@@ -1,4 +1,6 @@
-from . import flightrec, heartbeat, registry, scoreboard, tracing, xla
+from . import (fleet, flightrec, heartbeat, registry, scoreboard, server,
+               slo, tracing, xla)
+from .fleet import FleetMonitor, fleet_view
 from .flightrec import FlightRecorder
 from .heartbeat import Heartbeat
 from .metrics import MetricsLogger, emit_run_summary
@@ -8,7 +10,9 @@ from .plots import (plot_metrics, plot_score_stats, plot_scores,
 from .profiler import ProfileWindow, StepTimer, trace
 from .registry import MetricsRegistry
 from .scoreboard import Scoreboard
+from .server import StatusServer
 from .session import ObsSession
+from .slo import SloEngine
 from .tracing import Tracer
 from .xla import HbmMonitor, XlaIntrospector
 
@@ -18,4 +22,6 @@ __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
            "Tracer", "MetricsRegistry", "Heartbeat", "FlightRecorder",
            "ObsSession", "emit_run_summary", "tracing", "registry",
            "heartbeat", "flightrec", "xla", "XlaIntrospector", "HbmMonitor",
-           "ProfileWindow", "scoreboard", "Scoreboard"]
+           "ProfileWindow", "scoreboard", "Scoreboard",
+           "server", "StatusServer", "fleet", "FleetMonitor", "fleet_view",
+           "slo", "SloEngine"]

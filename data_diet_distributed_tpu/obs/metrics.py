@@ -126,6 +126,20 @@ def emit_run_summary(logger: MetricsLogger, *, wall_s: float, exit_class: str,
             mfu = registry.snapshot()["gauges"].get("mfu")
             if mfu is not None:
                 record["mfu"] = mfu
+    from . import server as obs_server
+    srv = obs_server.current()
+    if srv is not None and srv.port is not None:
+        # The live-introspection endpoint this run served: a reader of the
+        # terminal record (or a supervisor restarting the run) knows where
+        # the next incarnation's endpoints will be looked for.
+        record["server_port"] = srv.port
+    from . import slo as obs_slo
+    engine = obs_slo.current()
+    if engine is not None:
+        # Final SLO verdict: ok/violation count + the recent violations —
+        # the terminal record answers "was the run healthy", not just "how
+        # fast was it".
+        record["slo"] = engine.verdict()
     from . import scoreboard as obs_scoreboard
     stability = obs_scoreboard.summary()
     if stability:

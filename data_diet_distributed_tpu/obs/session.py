@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import os
 
-from . import flightrec, heartbeat, registry, scoreboard, tracing, xla
+from . import (fleet, flightrec, heartbeat, registry, scoreboard, server,
+               slo, tracing, xla)
 from .profiler import ProfileWindow
 
 DEFAULT_TRACE_NAME = "trace.json"
@@ -49,6 +50,9 @@ class ObsSession:
         self.recorder: flightrec.FlightRecorder | None = None
         self.xla: xla.XlaIntrospector | None = None
         self.scoreboard: scoreboard.Scoreboard | None = None
+        self.server: server.StatusServer | None = None
+        self.slo: slo.SloEngine | None = None
+        self.fleet: fleet.FleetMonitor | None = None
 
     def __enter__(self) -> "ObsSession":
         import jax
@@ -82,6 +86,30 @@ class ObsSession:
             # module slot (one is-None check when disabled).
             self.scoreboard = scoreboard.install(scoreboard.Scoreboard(
                 logger=self.logger, bins=cfg.obs.score_hist_bins))
+        # SLO engine: None unless the config declares at least one
+        # objective. Installed before the server so /healthz sees it from
+        # the first request.
+        engine = slo.SloEngine.from_cfg(cfg, logger=self.logger)
+        if engine is not None:
+            self.slo = slo.install(engine)
+        if hb_dir is not None and cfg.obs.fleet:
+            self.fleet = fleet.install(fleet.FleetMonitor(
+                hb_dir,
+                stale_budget_s=(cfg.obs.slo_heartbeat_stale_s
+                                or fleet.DEFAULT_STALE_BUDGET_S),
+                logger=self.logger))
+            if jax.process_count() > 1:
+                # The independent sampling thread: fleet_status records on
+                # straggler transitions even while the training thread is
+                # wedged in a dead collective. Multi-rank only — a
+                # single-rank fleet has nobody to lag behind.
+                self.fleet.start_watch()
+        if cfg.obs.server_port is not None:
+            self.server = server.install(server.StatusServer(
+                port=cfg.obs.server_port, host=cfg.obs.server_host,
+                stale_after_s=cfg.obs.slo_heartbeat_stale_s,
+                logger=self.logger))
+            self.server.start()   # bind failure degrades inside (warn once)
         # A session is a fresh run: clear the process-wide profile-window
         # bookkeeping so this run's stages can capture again (tests enter
         # many sessions per process).
@@ -103,6 +131,11 @@ class ObsSession:
                 self.registry.write_prometheus(self.registry.prom_path)
             except OSError:
                 pass   # a dying disk must not mask the run's own outcome
+        if self.server is not None:
+            self.server.stop()
+        server.uninstall()
+        fleet.uninstall()   # stops the watch thread
+        slo.uninstall()
         scoreboard.uninstall()
         xla.uninstall()
         flightrec.uninstall()

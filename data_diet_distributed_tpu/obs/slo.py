@@ -1,0 +1,317 @@
+"""SLO engine: config-declared objectives evaluated at run boundaries.
+
+The perf sentry (``tools/perf_sentry.py``) judges a run AFTER it ends; the
+watchdog judges liveness only. This module closes the gap in between: a
+small set of service-level objectives declared in config (``cfg.obs.slo_*``)
+and evaluated DURING the run at the points where their inputs exist —
+
+* **throughput floor** — steady-epoch ``examples_per_s`` must not fall
+  below ``slo_throughput_floor`` (absolute) and/or ``slo_throughput_frac``
+  × the trailing baseline from the perf-history ledger (the same
+  clean-record discipline as the sentry: error records, non-ok exit
+  classes, and non-positive values can never form a baseline). Checked at
+  epoch boundaries, warmup epoch excluded (compile is not a regression).
+* **eval-accuracy floor** — ``slo_eval_accuracy_floor`` against each eval
+  pass's test accuracy.
+* **nonfinite-score budget** — ``slo_nonfinite_frac`` against the fraction
+  of NaN/inf entries in each scoring pass's output.
+* **heartbeat staleness budget** — ``slo_heartbeat_stale_s`` against the
+  stalest rank's heartbeat age at epoch boundaries (the live /healthz
+  verdict uses the same budget continuously; the boundary check is what
+  leaves a durable record when a straggler recovers between polls).
+
+Each violation emits ONE ``{"kind": "slo_violation"}`` JSONL record (the
+MetricsLogger mirrors every event into the fault flight recorder before its
+process-0 gate, so the ring holds it on every rank), increments the
+``slo_violations`` counter, updates ``slo_ok`` / ``slo_margin:<name>``
+gauges, and is retained (bounded) for the ``/healthz`` verdict and the
+bench's final-verdict block. Repeated violations of the same objective at
+new evaluation points are new records — a sustained collapse is a sustained
+fact — but the engine never re-emits for the SAME evaluation point.
+
+Module-level slot, no-op until installed, like every obs instrument.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["SloEngine", "ledger_baseline", "install", "uninstall", "current",
+           "check_epoch", "check_scores", "DEFAULT_BASELINE_WINDOW"]
+
+#: Trailing clean records forming the ledger baseline (the sentry's window).
+DEFAULT_BASELINE_WINDOW = 5
+
+#: Retained violations (healthz / bench verdict); the JSONL holds them all.
+MAX_RETAINED = 64
+
+
+def _clean_value(rec: dict, field: str) -> float | None:
+    """The sentry's clean-record discipline, applied to one field: error
+    records, non-ok exit classes, and non-positive/non-numeric values can
+    never enter a baseline."""
+    if rec.get("error") or rec.get("exit_class") not in (None, "ok"):
+        return None
+    v = rec.get(field)
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return None
+    v = float(v)
+    if v != v or v <= 0:
+        return None
+    return v
+
+
+def ledger_baseline(path: str | None, *, field: str = "examples_per_s",
+                    metric: str | None = None, geometry: dict | None = None,
+                    backend: str | None = None,
+                    window: int = DEFAULT_BASELINE_WINDOW) -> float | None:
+    """Trailing median of the last ``window`` CLEAN ``perf_history`` records'
+    ``field`` (optionally filtered to one metric / geometry shape / backend —
+    the sentry's grouping discipline: runs are only ever compared against
+    runs of the same shape). None when the ledger is absent or holds no
+    clean matching record — no baseline is a valid state, never a zero."""
+    if not path or not os.path.exists(path):
+        return None
+    values: list[float] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) or rec.get("kind") != "perf_history":
+                    continue
+                if metric is not None and rec.get("metric") != metric:
+                    continue
+                if geometry is not None and rec.get("geometry") != geometry:
+                    continue
+                if backend is not None and rec.get("backend") != backend:
+                    continue
+                v = _clean_value(rec, field)
+                if v is not None:
+                    values.append(v)
+    except OSError:
+        return None
+    if not values:
+        return None
+    return float(np.median(values[-window:]))
+
+
+class SloEngine:
+    def __init__(self, *, throughput_floor: float | None = None,
+                 throughput_frac: float | None = None,
+                 ledger: str | None = None,
+                 heartbeat_stale_s: float | None = None,
+                 nonfinite_frac: float | None = None,
+                 eval_accuracy_floor: float | None = None,
+                 baseline_window: int = DEFAULT_BASELINE_WINDOW,
+                 geometry: dict | None = None, logger=None):
+        self.throughput_floor = throughput_floor
+        self.throughput_frac = throughput_frac
+        self.ledger = ledger
+        # The ledger-baseline grouping key (the sentry's discipline: never
+        # compare against runs of a different shape). None = unfiltered —
+        # only for callers whose ledger holds one shape by construction.
+        self.geometry = geometry
+        self.heartbeat_stale_s = heartbeat_stale_s
+        self.nonfinite_frac = nonfinite_frac
+        self.eval_accuracy_floor = eval_accuracy_floor
+        self.baseline_window = baseline_window
+        self.logger = logger
+        self.violations: list[dict] = []   # bounded retention (MAX_RETAINED)
+        self.total_violations = 0          # exact count, never trimmed
+        # Ledger read once, lazily, at the first steady check — not at
+        # construction (the ledger may not exist until the run appends).
+        self._baseline: float | None = None
+        self._baseline_resolved = False
+        self._seen_points: set = set()
+
+    @classmethod
+    def from_cfg(cls, cfg, logger=None) -> "SloEngine | None":
+        """None when the config declares no objective — the engine is pure
+        opt-in, like every obs instrument."""
+        o = cfg.obs
+        if not any((o.slo_throughput_floor, o.slo_throughput_frac,
+                    o.slo_heartbeat_stale_s, o.slo_nonfinite_frac,
+                    o.slo_eval_accuracy_floor)):
+            return None
+        # The SAME geometry block cli._append_perf_ledger writes: the
+        # baseline this run is held to is the trail of runs of its own shape.
+        geometry = {"dataset": cfg.data.dataset, "arch": cfg.model.arch,
+                    "batch": cfg.data.batch_size,
+                    "epochs": cfg.train.num_epochs,
+                    "method": cfg.score.method}
+        return cls(throughput_floor=o.slo_throughput_floor,
+                   throughput_frac=o.slo_throughput_frac,
+                   ledger=o.perf_ledger, geometry=geometry,
+                   heartbeat_stale_s=o.slo_heartbeat_stale_s,
+                   nonfinite_frac=o.slo_nonfinite_frac,
+                   eval_accuracy_floor=o.slo_eval_accuracy_floor,
+                   logger=logger)
+
+    # ----------------------------------------------------------- plumbing
+
+    def objectives(self) -> dict:
+        """The configured floors/budgets (for /status and the docs' curl
+        examples) — resolved throughput floor included once known."""
+        out = {k: getattr(self, k) for k in
+               ("throughput_floor", "throughput_frac", "heartbeat_stale_s",
+                "nonfinite_frac", "eval_accuracy_floor")
+               if getattr(self, k) is not None}
+        if self._baseline_resolved:
+            out["throughput_baseline"] = self._baseline
+        return out
+
+    def _resolved_floor(self) -> float | None:
+        """The effective throughput floor: max of the absolute floor and
+        frac × trailing ledger baseline (whichever are configured)."""
+        floors = []
+        if self.throughput_floor is not None:
+            floors.append(float(self.throughput_floor))
+        if self.throughput_frac is not None:
+            if not self._baseline_resolved:
+                try:
+                    import jax
+                    backend = jax.default_backend()
+                except Exception:   # noqa: BLE001 — engine is usable without jax
+                    backend = None
+                self._baseline = ledger_baseline(
+                    self.ledger, geometry=self.geometry, backend=backend,
+                    window=self.baseline_window)
+                self._baseline_resolved = True
+            if self._baseline is not None:
+                floors.append(self.throughput_frac * self._baseline)
+        return max(floors) if floors else None
+
+    def _violate(self, name: str, value, threshold, *, logger=None,
+                 point=None, **ctx) -> None:
+        if point is not None:
+            key = (name, point)
+            if key in self._seen_points:
+                return   # one record per (objective, evaluation point)
+            self._seen_points.add(key)
+        rec = {"slo": name, "value": value, "threshold": threshold, **ctx}
+        self.violations.append(rec)
+        self.total_violations += 1
+        del self.violations[:-MAX_RETAINED]
+        from . import registry as obs_registry
+        obs_registry.inc("slo_violations")
+        obs_registry.set_gauge("slo_ok", 0.0)
+        if isinstance(value, (int, float)) and isinstance(threshold,
+                                                          (int, float)):
+            obs_registry.set_gauge(f"slo_margin:{name}",
+                                   float(value) - float(threshold))
+        logger = logger or self.logger
+        if logger is not None:
+            logger.log("slo_violation", **rec)
+
+    def _mark_ok(self) -> None:
+        if not self.violations:
+            from . import registry as obs_registry
+            obs_registry.set_gauge("slo_ok", 1.0)
+
+    def verdict(self) -> dict:
+        """The run-so-far verdict (``/healthz`` slo block; bench JSON)."""
+        return {"ok": self.total_violations == 0,
+                "violations": self.total_violations,
+                "recent": self.violations[-5:],
+                "objectives": self.objectives()}
+
+    # --------------------------------------------------- evaluation points
+
+    def check_epoch(self, *, tag: str, epoch: int,
+                    examples_per_s: float | None = None,
+                    eval_accuracy: float | None = None,
+                    steady: bool = True, logger=None) -> None:
+        """Epoch-boundary evaluation: throughput floor (steady epochs only —
+        the compile epoch is not a regression), eval-accuracy floor, and the
+        heartbeat staleness budget across all ranks."""
+        if steady and examples_per_s is not None:
+            floor = self._resolved_floor()
+            if floor is not None and examples_per_s < floor:
+                self._violate("throughput", round(float(examples_per_s), 1),
+                              round(floor, 1), logger=logger,
+                              point=("epoch", tag, epoch), tag=tag,
+                              epoch=epoch, baseline=self._baseline)
+        if (eval_accuracy is not None
+                and self.eval_accuracy_floor is not None
+                and eval_accuracy < self.eval_accuracy_floor):
+            self._violate("eval_accuracy", round(float(eval_accuracy), 4),
+                          self.eval_accuracy_floor, logger=logger,
+                          point=("eval", tag, epoch), tag=tag, epoch=epoch)
+        if self.heartbeat_stale_s is not None and steady:
+            # The compile epoch is exempt like the throughput floor: a
+            # multi-second first dispatch is not a stalled rank.
+            self._check_heartbeats(tag=tag, epoch=epoch, logger=logger)
+        self._mark_ok()
+
+    def _check_heartbeats(self, *, tag: str, epoch: int, logger=None) -> None:
+        from . import heartbeat as obs_heartbeat
+        hb = obs_heartbeat.current()
+        if hb is None:
+            return
+        from .fleet import fleet_view
+        view = fleet_view(hb.directory,
+                          stale_budget_s=self.heartbeat_stale_s)
+        if view is None or view["straggler_rank"] is None:
+            return
+        self._violate("heartbeat_staleness", view["stalest_age_s"],
+                      self.heartbeat_stale_s, logger=logger,
+                      point=("heartbeat", tag, epoch), tag=tag, epoch=epoch,
+                      rank=view["straggler_rank"],
+                      reason=view["straggler_reason"])
+
+    def check_scores(self, method: str, scores, *, logger=None) -> None:
+        """Scoring-pass evaluation: the nonfinite-score budget over the
+        final score vector (a scoring pass whose output is part-NaN is a
+        quality incident even when nothing crashed)."""
+        if self.nonfinite_frac is None:
+            return
+        arr = np.asarray(scores)
+        if arr.size == 0:
+            return
+        frac = float(np.mean(~np.isfinite(arr)))
+        if frac > self.nonfinite_frac:
+            self._violate("nonfinite_scores", round(frac, 6),
+                          self.nonfinite_frac, logger=logger,
+                          point=("scores", method), method=method,
+                          n=int(arr.size))
+        self._mark_ok()
+
+
+# --------------------------------------------------------- module-level slot
+
+_ENGINE: SloEngine | None = None
+
+
+def install(engine: SloEngine) -> SloEngine:
+    global _ENGINE
+    _ENGINE = engine
+    return engine
+
+
+def uninstall() -> None:
+    global _ENGINE
+    _ENGINE = None
+
+
+def current() -> SloEngine | None:
+    return _ENGINE
+
+
+def check_epoch(**kwargs) -> None:
+    """Library-code entry: no-op until an engine is installed."""
+    if _ENGINE is not None:
+        _ENGINE.check_epoch(**kwargs)
+
+
+def check_scores(method: str, scores, *, logger=None) -> None:
+    if _ENGINE is not None:
+        _ENGINE.check_scores(method, scores, logger=logger)

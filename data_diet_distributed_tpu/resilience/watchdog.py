@@ -119,6 +119,17 @@ class Watchdog:
     def fired(self) -> bool:
         return self._fired
 
+    def status(self) -> dict:
+        """Health-surface view (the obs status server's /healthz watchdog
+        block): remaining deadline margin in seconds (None while suspended —
+        an indefinite deadline has no meaningful margin), the deadline
+        itself, and whether the guard fired."""
+        margin = self._deadline - time.monotonic()
+        return {"label": self.label, "timeout_s": self.timeout_s,
+                "fired": self._fired,
+                "margin_s": (None if margin == float("inf")
+                             else round(margin, 3))}
+
     def beat(self) -> None:
         self._deadline = time.monotonic() + self.timeout_s
 

@@ -35,9 +35,12 @@ from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              maybe_resident, num_batches)
 from ..models import create_model_from_cfg
 from ..obs import MetricsLogger, flightrec, tracing
+from ..obs import fleet as obs_fleet
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
 from ..obs import scoreboard as obs_scoreboard
+from ..obs import server as obs_server
+from ..obs import slo as obs_slo
 from ..obs import xla as obs_xla
 from ..obs.profiler import ProfileWindow
 from ..ops.scoring import score_dataset
@@ -413,6 +416,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
             profile.close()   # a mid-capture exception must stop the profiler
         if ckpt is not None:
             ckpt.close()
+        # The status server's /healthz must not keep reading THIS fit's
+        # watchdog/consensus after they are gone (nested fits re-attach).
+        obs_server.detach("watchdog", "consensus")
     result.wall_s = time.perf_counter() - t_start
     return result
 
@@ -498,6 +504,16 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 augment=None, profile=None):
     chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding)
                 if chunk_steps > 1 else None)
+    # Live-introspection wiring (no-op unless a status server is installed):
+    # /healthz reads this fit's watchdog margin + consensus poison state
+    # directly; /status derives its ETA from the dispatch accounting the
+    # loop reports below.
+    obs_server.attach(watchdog=watchdog, consensus=consensus)
+    obs_server.note_progress(
+        stage=tag, total_epochs=cfg.train.num_epochs,
+        steps_per_epoch=steps_per_epoch, chunk_steps=chunk_steps,
+        epochs_done=start_epoch, epoch=start_epoch, dispatches_done=0,
+        dispatches_per_epoch=-(-steps_per_epoch // chunk_steps))
     # Host-side optimizer-step accounting for log events (fetching state.step
     # per log would block the pipeline). The offset is nonzero only after
     # resuming a MID-EPOCH preemption checkpoint, where the replayed epoch's
@@ -544,6 +560,11 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 # backends without memory_stats, e.g. CPU).
                 obs_xla.poll_memory()
                 prev_done, done = done, done + idx.shape[0]
+                # /status progress at the chunk boundary: step + dispatch
+                # counts, the ETA's intra-epoch progress signal.
+                obs_server.note_progress(
+                    step=epoch * steps_per_epoch + done,
+                    dispatches_done=-(-done // chunk_steps))
                 if (done // cfg.train.log_every_steps
                         > prev_done // cfg.train.log_every_steps):
                     # The log_every_steps hook, hoisted like the rest: a
@@ -595,6 +616,11 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 if train_resident is None and i >= 8:
                     step_metrics[i - 8] = jax.device_get(step_metrics[i - 8])
                 if (i + 1) % cfg.train.log_every_steps == 0:
+                    # /status progress on the logging cadence (host
+                    # arithmetic only — the per-step path must stay
+                    # dispatch-bound, not observability-bound).
+                    obs_server.note_progress(step=unit + 1,
+                                             dispatches_done=i + 1)
                     # Log ONLY already-on-host data: float(metrics["loss"]) /
                     # int(state.step) here would block on the just-dispatched
                     # step and serialize the pipeline this loop is built to
@@ -674,6 +700,18 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
         obs_registry.inc("steps", steps_per_epoch)
         obs_registry.observe("epoch_s", epoch_s)
         obs_registry.set_gauge("examples_per_s", record["examples_per_s"])
+        # Live-introspection epoch boundary: /status progress + ETA inputs,
+        # the SLO engine's evaluation point (throughput floor on steady
+        # epochs, eval-accuracy floor, heartbeat-staleness budget), and the
+        # rank-0 fleet_status record. All no-ops when nothing is installed.
+        obs_server.note_progress(epoch=epoch, epochs_done=epoch + 1,
+                                 epoch_s=epoch_s, dispatches_done=0,
+                                 examples_per_s=record["examples_per_s"])
+        obs_slo.check_epoch(tag=tag, epoch=epoch,
+                            examples_per_s=record["examples_per_s"],
+                            eval_accuracy=record.get("test_accuracy"),
+                            steady=epoch > start_epoch, logger=logger)
+        obs_fleet.maybe_emit(logger)
         if epoch > start_epoch:
             # MFU from the harvested program's flops/example at this epoch's
             # steady-state throughput (epoch 0 folds compile into the wall,
@@ -1067,6 +1105,9 @@ def compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
     # pass had fewer than two seeds.
     obs_scoreboard.note_stability(cfg.score.method,
                                   keep_fractions=keep_fractions(cfg))
+    # Scoring-pass SLO point: the nonfinite-score budget over the final
+    # vector (no-op unless an engine with that objective is installed).
+    obs_slo.check_scores(cfg.score.method, scores, logger=logger)
     obs_registry.observe("score_s", timings["score_s"])
     obs_registry.observe("score_pretrain_s", timings["pretrain_s"])
     if timings.get("passes") and timings["score_s"] > 0:

@@ -37,6 +37,108 @@ import sys
 EXIT_DIVERGED = 13
 
 
+def fleet_scenario(pid: int, out_dir: str) -> None:
+    """Fleet-view drill (obs/fleet.py + obs/server.py): rank 1 deliberately
+    stalls between epochs; rank 0 serves the live endpoints, runs the fleet
+    watch thread, and polls its own /healthz while its main thread blocks in
+    the collective the stalled peer never reaches. Asserts the PR's live-
+    introspection contract at process-count 2: the slowed rank is NAMED in
+    ``fleet_status`` records and /healthz flips ok -> degraded (and back).
+    Writes observations as result JSON; the parent asserts on them."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import BatchSharder
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    from data_diet_distributed_tpu.obs import fleet as obs_fleet
+    from data_diet_distributed_tpu.obs import heartbeat as obs_heartbeat
+    from data_diet_distributed_tpu.obs import server as obs_server
+    from data_diet_distributed_tpu.parallel.mesh import make_mesh
+    from data_diet_distributed_tpu.train.loop import fit
+
+    stall_s, budget_s = 3.0, 0.8
+    hb_dir = os.path.join(out_dir, "heartbeats")
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=3",
+        "train.half_precision=false", "train.device_resident_data=false",
+        "train.log_every_steps=1000", "train.checkpoint_every=100",
+        f"train.checkpoint_dir={out_dir}/ckpt",
+        f"obs.metrics_path={out_dir}/metrics.jsonl",
+        f"obs.heartbeat_dir={hb_dir}", "obs.heartbeat_interval_s=0.05",
+        # Same rationale as the baseline scenario: this lane pins the fleet
+        # view, not the consensus collectives (which have their own lane).
+        "resilience.consensus=false",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+    ])
+    mesh = make_mesh(None)
+    sharder = BatchSharder(mesh)
+    train_ds, _ = load_dataset("synthetic", synthetic_size=256, seed=0)
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    obs_heartbeat.install(obs_heartbeat.Heartbeat(hb_dir, pid,
+                                                  min_interval_s=0.05))
+    result = {"pid": pid, "scenario": "fleet_straggler"}
+    seen = {"verdicts": set(), "stale_named": False}
+    stop = threading.Event()
+    server = monitor = poller = None
+    if pid == 0:
+        server = obs_server.install(obs_server.StatusServer(
+            port=0, stale_after_s=budget_s, logger=logger))
+        assert server.start(), "rank 0 could not bind the status server"
+        monitor = obs_fleet.install(obs_fleet.FleetMonitor(
+            hb_dir, stale_budget_s=budget_s, logger=logger))
+        monitor.start_watch(0.2)
+
+        def poll(url=f"http://127.0.0.1:{server.port}/healthz"):
+            while not stop.wait(0.1):
+                try:
+                    with urllib.request.urlopen(url, timeout=1) as resp:
+                        h = _json.load(resp)
+                except Exception:   # noqa: BLE001 — transient poll misses are fine
+                    continue
+                seen["verdicts"].add(h["status"])
+                if any("rank1" in r for r in h.get("reasons", [])):
+                    seen["stale_named"] = True
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+
+    def hook(model, state, epoch):
+        if pid == 1 and epoch == 1:
+            time.sleep(stall_s)   # the deliberate straggle: rank 1 only
+
+    res = fit(cfg, train_ds, None, mesh=mesh, sharder=sharder, logger=logger,
+              epoch_hook=hook)
+    if pid == 0:
+        # One more boundary emit after recovery, then let the poller catch a
+        # final (healthy-again) verdict before teardown.
+        time.sleep(3 * 0.1 + 0.2)
+        stop.set()
+        poller.join(timeout=5)
+        final_view = monitor.view()
+        result.update(verdicts=sorted(seen["verdicts"]),
+                      stale_named=seen["stale_named"],
+                      server_port=server.port,
+                      final_view=final_view)
+        obs_fleet.uninstall()
+        server.stop()
+        obs_server.uninstall()
+    result.update(outcome="completed", epochs_run=[r["epoch"]
+                                                   for r in res.history])
+    logger.close()
+    with open(os.path.join(out_dir, f"result_{pid}.json"), "w") as fh:
+        json.dump(result, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def consensus_scenario(scenario: str, pid: int, out_dir: str) -> None:
     """Drive one consensus fault drill; write result JSON; exit with the
     status the CLI contract assigns the outcome (75 preempted, 69 retriable
@@ -165,6 +267,9 @@ def main() -> None:
     assert len(jax.devices()) == 4 * nprocs
     assert is_primary() == (pid == 0)
 
+    if scenario == "fleet_straggler":
+        fleet_scenario(pid, out_dir)
+        return
     if scenario != "baseline":
         consensus_scenario(scenario, pid, out_dir)
         return

@@ -244,3 +244,86 @@ def test_bench_score_embeds_score_quality_block():
     assert stab["n_seeds"] == 2
     assert -1.0 <= stab["spearman_pairwise_mean"] <= 1.0
     assert "0.5" in stab["overlap_at_keep"]
+
+
+def test_bench_serve_port_and_slo_verdict(tmp_path):
+    """--serve-port serves the live endpoints for the duration of the timed
+    task (polled from the parent while the bench runs) and the JSON embeds
+    the serving-cost block plus the final SLO verdict vs the trailing ledger
+    baseline — health next to throughput, in one line."""
+    import re
+    import urllib.request
+
+    ledger = tmp_path / "perf_history.jsonl"
+    geometry = {"task": "score", "arch": "tiny_cnn", "dataset": "synthetic",
+                "size": 128, "batch": 64, "method": "el2n", "mesh": None,
+                "num_processes": 1}
+    with open(ledger, "w") as fh:
+        for _ in range(3):   # a clean trailing baseline any real run beats
+            fh.write(json.dumps({"kind": "perf_history", "backend": "cpu",
+                                 "metric": "el2n_scoring_examples_per_sec_per_chip",
+                                 "value": 1.0, "unit": "examples/sec/chip",
+                                 "geometry": geometry}) + "\n")
+        # A same-metric TPU record that must NOT enter the CPU baseline
+        # (the sentry's backend grouping).
+        fh.write(json.dumps({"kind": "perf_history", "backend": "tpu",
+                             "metric": "el2n_scoring_examples_per_sec_per_chip",
+                             "value": 1e9, "unit": "examples/sec/chip",
+                             "geometry": geometry}) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--ledger", str(ledger),
+         "--size", "128", "--batch", "64", "--arch", "tiny_cnn",
+         "--method", "el2n", "--seeds", "1", "--repeats", "1", "--chunk",
+         "4", "--no-probe", "--serve-port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=REPO,
+        env=env)
+    try:
+        port = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "bench exited before announcing the server"
+            m = re.search(r"listening on http://127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "no status-server announcement"
+        polled = 0
+        while proc.poll() is None and time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1) as r:
+                    assert json.load(r)["status"] in ("ok", "degraded")
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=1) as r:
+                    r.read()
+                polled += 1
+            except OSError:
+                pass   # server tearing down as the task ends
+            time.sleep(0.3)
+        out, err = proc.communicate(timeout=60)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, err[-800:]
+    lines = [json.loads(ln) for ln in out.splitlines() if ln.startswith("{")]
+    assert lines, out
+    line = lines[-1]
+    assert line["value"] > 0
+    assert polled >= 1, "never reached the live endpoints during the task"
+    # Serving cost: measured, riding the JSON (and >= the parent's polls).
+    assert line["serve"]["port"] == port
+    # The stats snapshot rides the emit, which precedes our last polls —
+    # assert on a lower bound, not an exact count.
+    assert line["serve"]["requests"] >= 2
+    assert line["serve"]["handle_s"] >= 0
+    # Final SLO verdict vs the trailing clean baseline (1.0 ex/s/chip: any
+    # real run beats it).
+    assert line["slo"]["verdict"] == "ok"
+    assert line["slo"]["baseline"] == 1.0
+    assert line["slo"]["delta_frac"] > 0
+    # The verdict rides the ledger record too (perf_sentry's input).
+    recs = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert recs[-1]["kind"] == "perf_history"
+    assert recs[-1]["slo"]["verdict"] == "ok"
+    assert recs[-1]["serve"]["requests"] >= 2

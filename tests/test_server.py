@@ -1,0 +1,260 @@
+"""Live introspection server (obs/server.py) + tools/run_monitor.py.
+
+The tier-1 acceptance lane for the embedded status/health endpoints: a tiny
+REAL fit runs with the server installed (via the production ObsSession
+wiring) and every endpoint must answer with a well-formed payload — /healthz
+with the verdict schema, /metrics as parseable Prometheus text from the LIVE
+registry, /status with a finite ETA from the first steady epoch, /flightrec
+with the ring. Port-collision robustness (bind failures degrade to a no-op
+with one warning; port 0 auto-picks distinct ports for concurrent servers)
+and the stall drill (a stalled rank flips /healthz ok -> degraded NAMING the
+rank) are pinned here too; the 2-process fleet version lives in
+test_fleet_multihost.py.
+"""
+
+import importlib.util
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger, emit_run_summary
+from data_diet_distributed_tpu.obs import server as obs_server
+from data_diet_distributed_tpu.obs.server import StatusServer
+from data_diet_distributed_tpu.obs.session import ObsSession
+from data_diet_distributed_tpu.train.loop import fit
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_metrics", REPO / "tools" / "validate_metrics.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fetch(port, path, timeout=5.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        body = resp.read()
+        return resp.status, resp.headers.get("Content-Type", ""), body
+
+
+def _fetch_json(port, path):
+    _, _, body = _fetch(port, path)
+    return json.loads(body)
+
+
+def _cfg(tmp_path, *extra):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=3",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        f"obs.heartbeat_dir={tmp_path}/hb", "obs.heartbeat_interval_s=0.05",
+        "obs.server_port=0",
+        "score.pretrain_epochs=0", "score.batch_size=64", *extra])
+
+
+#: Prometheus text line: `name{labels} value` or `name value` (or comments).
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+naif-]+$")
+
+
+class TestEndpointsDuringRealFit:
+    """CI satellite: every endpoint well-formed during a real CPU fit."""
+
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory, tiny_ds):
+        tmp_path = tmp_path_factory.mktemp("srv")
+        cfg = _cfg(tmp_path, "resilience.step_timeout_s=60")
+        logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+        train_ds, test_ds = tiny_ds
+        mid_run = {"status": [], "healthz": []}
+        with ObsSession(cfg, logger=logger) as obs:
+            assert obs.server is not None and obs.server.port
+            port = obs.server.port
+
+            def hook(model, state, epoch):
+                mid_run["status"].append(_fetch_json(port, "/status"))
+                mid_run["healthz"].append(_fetch_json(port, "/healthz"))
+
+            fit(cfg, train_ds, test_ds, logger=logger, epoch_hook=hook)
+            final = {
+                "healthz": _fetch(port, "/healthz"),
+                "metrics": _fetch(port, "/metrics"),
+                "status": _fetch_json(port, "/status"),
+                "flightrec": _fetch_json(port, "/flightrec"),
+                "unknown": None,
+            }
+            try:
+                _fetch(port, "/nope")
+            except urllib.error.HTTPError as err:
+                final["unknown"] = (err.code, json.load(err))
+            summary = emit_run_summary(logger, wall_s=1.0, exit_class="ok",
+                                       registry=obs.registry)
+            stats = obs.server.stats()
+        logger.close()
+        return dict(cfg=cfg, port=port, mid=mid_run, final=final,
+                    summary=summary, stats=stats, tmp_path=tmp_path)
+
+    def test_healthz_schema_and_ok_verdict(self, run):
+        code, ctype, body = run["final"]["healthz"]
+        assert code == 200 and "json" in ctype
+        h = json.loads(body)
+        assert set(h) >= {"status", "reasons", "ts", "watchdog",
+                          "heartbeats", "consensus", "slo"}
+        assert h["status"] == "ok" and h["reasons"] == []
+        assert h["heartbeats"]["ranks"] == 1
+        assert h["heartbeats"]["stalest_rank"] == 0
+        assert h["consensus"] == {"enabled": False, "poisoned": False,
+                                  "poison": None}
+
+    def test_watchdog_block_live_while_armed(self, run):
+        armed = [h["watchdog"] for h in run["mid"]["healthz"]]
+        assert all(w["armed"] for w in armed)
+        assert all(not w["fired"] for w in armed)
+        # Mid-fit the guard was freshly beaten: real positive margin.
+        assert all(0 < w["margin_s"] <= w["timeout_s"] for w in armed)
+        # After fit, the watchdog is detached: /healthz must not read a
+        # dead guard's (expired) deadline.
+        final = json.loads(run["final"]["healthz"][2])
+        assert final["watchdog"] == {"armed": False}
+
+    def test_metrics_endpoint_is_live_prometheus_text(self, run):
+        code, ctype, body = run["final"]["metrics"]
+        assert code == 200 and ctype.startswith("text/plain")
+        lines = body.decode().strip().splitlines()
+        assert lines, "empty /metrics"
+        for line in lines:
+            if line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line), f"unparseable prometheus: {line!r}"
+        names = {line.split("{")[0].split(" ")[0] for line in lines
+                 if not line.startswith("#")}
+        # Live registry content, not a stale textfile: the fit's instruments.
+        assert "ddt_epochs" in names
+        assert any(n.startswith("ddt_epoch_s") for n in names)
+
+    def test_status_eta_finite_after_first_steady_epoch(self, run):
+        # epoch_hook fires after each epoch's eval; from the hook at epoch 1
+        # on, one full epoch wall exists and the ETA must be a finite float.
+        by_epoch = run["mid"]["status"]
+        assert by_epoch[0]["eta_s"] is None   # no completed epoch yet
+        for st in by_epoch[1:]:
+            assert isinstance(st["eta_s"], float)
+            assert 0.0 <= st["eta_s"] < 1e6
+        st = by_epoch[1]
+        assert st["stage"] == "train"
+        assert st["total_epochs"] == 3 and st["epochs_done"] == 1
+        assert st["examples_per_s"] > 0
+        assert "dispatch" in st   # chunk/step dispatch accounting present
+
+    def test_flightrec_endpoint_serves_ring(self, run):
+        fr = run["final"]["flightrec"]
+        assert fr["installed"] and fr["rank"] == 0
+        kinds = {e["kind"] for e in fr["events"]}
+        assert "epoch" in kinds   # the logger mirrors every event into it
+
+    def test_unknown_path_404s_with_endpoint_list(self, run):
+        code, payload = run["final"]["unknown"]
+        assert code == 404
+        assert "/healthz" in payload["endpoints"]
+
+    def test_port_in_run_summary_and_stream_validates(self, run):
+        assert run["summary"]["server_port"] == run["port"]
+        records = [json.loads(line) for line in
+                   open(run["cfg"].obs.metrics_path) if line.strip()]
+        started = [r for r in records if r.get("kind") == "obs_server"]
+        assert started and started[0]["port"] == run["port"]
+        vm = _load_validator()
+        problems = vm.validate_lines(
+            [json.dumps(r) for r in records], where="stream",
+            expect_terminal=True)
+        assert problems == [], problems
+
+    def test_request_accounting(self, run):
+        stats = run["stats"]
+        assert stats["requests"] >= 8 and stats["handle_s"] >= 0
+
+
+def test_port_collision_degrades_to_noop_with_warning(capfd):
+    a = StatusServer(port=0)
+    assert a.start()
+    try:
+        b = StatusServer(port=a.port)
+        assert b.start() is False   # degraded, no exception
+        assert b.port is None
+        err = capfd.readouterr().err
+        assert "bind" in err and "disabled" in err
+        # The healthy server is unaffected.
+        assert _fetch_json(a.port, "/healthz")["status"] == "ok"
+    finally:
+        a.stop()
+
+
+def test_port_zero_autopicks_distinct_ports_concurrently():
+    servers = [StatusServer(port=0) for _ in range(2)]
+    try:
+        for s in servers:
+            assert s.start()
+        ports = [s.port for s in servers]
+        assert len(set(ports)) == 2
+        for p in ports:
+            assert _fetch_json(p, "/healthz")["status"] == "ok"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_stall_flips_healthz_degraded_naming_the_rank(tmp_path, tiny_ds):
+    """Acceptance: during a real CPU run, an injected stall (the rank stops
+    beating) flips /healthz ok -> degraded with a reason NAMING the stale
+    rank."""
+    cfg = _cfg(tmp_path, "obs.slo_heartbeat_stale_s=0.6")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    train_ds, _ = tiny_ds
+    seen = {"ok": False, "degraded": None}
+    with ObsSession(cfg, logger=logger) as obs:
+        port = obs.server.port
+
+        def hook(model, state, epoch):
+            if epoch == 1:
+                # Steady epoch: the last chunk-boundary beat is fresh (epoch
+                # 0's hook would see compile time as staleness), so the
+                # verdict reads ok BEFORE the stall...
+                seen["ok"] = _fetch_json(port, "/healthz")["status"] == "ok"
+                # ...then the injected stall: no heartbeat for > the 0.6s
+                # budget.
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.2)
+                    h = _fetch_json(port, "/healthz")
+                    if h["status"] == "degraded":
+                        seen["degraded"] = h
+                        break
+
+        fit(cfg, train_ds, None, logger=logger, epoch_hook=hook)
+    logger.close()
+    assert seen["ok"], "healthz was not ok before the stall"
+    h = seen["degraded"]
+    assert h is not None, "the stall never degraded /healthz"
+    assert any("rank0" in r and "stale" in r for r in h["reasons"]), h
+    assert h["heartbeats"]["stalest_rank"] == 0
+    assert h["heartbeats"]["stalest_age_s"] > 0.6
+
+
+def test_module_helpers_noop_when_uninstalled():
+    assert obs_server.current() is None
+    obs_server.note_progress(step=1)           # must not raise
+    obs_server.attach(watchdog=object())
+    obs_server.detach()

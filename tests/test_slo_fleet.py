@@ -1,0 +1,367 @@
+"""SLO engine (obs/slo.py) + fleet view (obs/fleet.py) + run_monitor exits.
+
+Acceptance lane: an injected throughput collapse (the run's steady
+throughput lands under a floor derived from a trailing perf-ledger baseline)
+emits a VALIDATED ``slo_violation`` record, surfaces in the run_summary
+verdict, and makes ``tools/run_monitor.py --once --json`` exit 1 with the
+violation in its JSON output — live (against the embedded server) and dead
+(from the metrics stream). Unit lanes pin the fleet merge (step lag,
+straggler naming, budget edge) and the ledger-baseline clean-record
+discipline the sentry established.
+"""
+
+import importlib.util
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger, emit_run_summary
+from data_diet_distributed_tpu.obs import fleet as obs_fleet
+from data_diet_distributed_tpu.obs import slo as obs_slo
+from data_diet_distributed_tpu.obs.fleet import FleetMonitor, fleet_view
+from data_diet_distributed_tpu.obs.session import ObsSession
+from data_diet_distributed_tpu.obs.slo import SloEngine, ledger_baseline
+from data_diet_distributed_tpu.train.loop import fit
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- fleet unit
+
+
+def _write_beat(directory, rank, *, age_s=0.0, step=None, epoch=None,
+                stage=None):
+    os.makedirs(directory, exist_ok=True)
+    rec = {"rank": rank, "ts": time.time() - age_s, "pid": 1, "host": "h"}
+    for k, v in (("step", step), ("epoch", epoch), ("stage", stage)):
+        if v is not None:
+            rec[k] = v
+    with open(os.path.join(directory, f"heartbeat_rank{rank}.json"),
+              "w") as fh:
+        json.dump(rec, fh)
+
+
+def test_fleet_view_merges_and_names_straggler(tmp_path):
+    d = str(tmp_path / "hb")
+    _write_beat(d, 0, age_s=0.1, step=100, epoch=3, stage="train")
+    _write_beat(d, 1, age_s=12.0, step=60, epoch=2, stage="train")
+    view = fleet_view(d, stale_budget_s=5.0)
+    assert view["n_ranks"] == 2
+    assert view["max_step"] == 100
+    by_rank = {r["rank"]: r for r in view["ranks"]}
+    assert by_rank[1]["lag"] == 40 and by_rank[0]["lag"] == 0
+    assert view["slowest_rank"] == 1 and view["max_lag"] == 40
+    assert view["stalest_rank"] == 1
+    assert view["stalest_age_s"] == pytest.approx(12.0, abs=2.0)
+    assert view["straggler_rank"] == 1
+    assert "rank1" in view["straggler_reason"]
+    assert "step 60" in view["straggler_reason"]
+
+
+def test_fleet_view_healthy_names_nobody(tmp_path):
+    d = str(tmp_path / "hb")
+    _write_beat(d, 0, age_s=0.1, step=10)
+    _write_beat(d, 1, age_s=0.2, step=10)
+    view = fleet_view(d, stale_budget_s=5.0)
+    assert view["straggler_rank"] is None
+    assert view["straggler_reason"] is None
+
+
+def test_fleet_view_none_without_heartbeats(tmp_path):
+    assert fleet_view(str(tmp_path / "empty")) is None
+
+
+def test_fleet_monitor_min_ranks_and_record(tmp_path):
+    d = str(tmp_path / "hb")
+    _write_beat(d, 0, age_s=0.0, step=5)
+    logged = []
+
+    class FakeLogger:
+        def log(self, kind, **fields):
+            logged.append({"kind": kind, **fields})
+
+    mon = FleetMonitor(d, stale_budget_s=5.0, logger=FakeLogger())
+    assert mon.emit() is None          # 1 rank < min_ranks: fleet silence
+    _write_beat(d, 1, age_s=9.0, step=1)
+    view = mon.emit()
+    assert view is not None and logged[-1]["kind"] == "fleet_status"
+    assert logged[-1]["straggler_rank"] == 1
+
+
+def test_fleet_watch_thread_emits_on_transition(tmp_path):
+    d = str(tmp_path / "hb")
+    _write_beat(d, 0, age_s=0.0, step=5)
+    _write_beat(d, 1, age_s=0.0, step=5)
+    logged = []
+
+    class FakeLogger:
+        def log(self, kind, **fields):
+            logged.append(fields)
+
+    mon = FleetMonitor(d, stale_budget_s=0.5, logger=FakeLogger())
+    mon.start_watch(0.05)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not logged:
+            time.sleep(0.05)   # both beats age past the 0.5s budget
+    finally:
+        mon.stop_watch()
+    assert logged, "watch thread never emitted on the staleness transition"
+    assert logged[0]["straggler_rank"] in (0, 1)
+    n = len(logged)
+    assert n <= 2, f"edge-trigger failed: {n} records for one transition"
+
+
+# --------------------------------------------------------------- slo unit
+
+
+def test_ledger_baseline_clean_record_discipline(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    recs = [
+        {"kind": "perf_history", "examples_per_s": 100.0},
+        {"kind": "perf_history", "examples_per_s": 110.0},
+        # wedge-shaped records can never enter a baseline:
+        {"kind": "perf_history", "examples_per_s": 0.0},
+        {"kind": "perf_history", "examples_per_s": 9999.0, "error": "wedge"},
+        {"kind": "perf_history", "examples_per_s": 9999.0,
+         "exit_class": "retriable"},
+        {"kind": "perf_history", "examples_per_s": 120.0},
+        {"not": "a perf record"},
+    ]
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    assert ledger_baseline(path) == 110.0          # median(100, 110, 120)
+    assert ledger_baseline(path, window=1) == 120.0
+    assert ledger_baseline(str(tmp_path / "missing.jsonl")) is None
+    assert ledger_baseline(None) is None
+
+
+def test_ledger_baseline_shape_discipline(tmp_path):
+    """Runs are only compared against runs of their own shape (the sentry's
+    grouping): a foreign geometry or backend can never form the baseline."""
+    path = str(tmp_path / "ledger.jsonl")
+    g1 = {"arch": "tiny_cnn", "batch": 64}
+    g2 = {"arch": "resnet18", "batch": 1024}
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "perf_history", "geometry": g1,
+                             "backend": "cpu", "examples_per_s": 100.0})
+                 + "\n")
+        fh.write(json.dumps({"kind": "perf_history", "geometry": g2,
+                             "backend": "cpu", "examples_per_s": 9.0}) + "\n")
+        fh.write(json.dumps({"kind": "perf_history", "geometry": g1,
+                             "backend": "tpu", "examples_per_s": 1e6}) + "\n")
+    assert ledger_baseline(path, geometry=g1, backend="cpu") == 100.0
+    assert ledger_baseline(path, geometry=g2, backend="cpu") == 9.0
+    assert ledger_baseline(path, geometry=g1, backend="tpu") == 1e6
+    assert ledger_baseline(path, geometry=g1, backend="rocm") is None
+
+
+def test_slo_engine_floors_and_dedupe(tmp_path):
+    logged = []
+
+    class FakeLogger:
+        def log(self, kind, **fields):
+            logged.append({"kind": kind, **fields})
+
+    eng = SloEngine(throughput_floor=1000.0, eval_accuracy_floor=0.5,
+                    nonfinite_frac=0.01, logger=FakeLogger())
+    eng.check_epoch(tag="t", epoch=1, examples_per_s=500.0,
+                    eval_accuracy=0.9)
+    assert [r["slo"] for r in logged] == ["throughput"]
+    assert logged[0]["value"] == 500.0 and logged[0]["threshold"] == 1000.0
+    # Same evaluation point never re-emits; a NEW point does.
+    eng.check_epoch(tag="t", epoch=1, examples_per_s=500.0)
+    eng.check_epoch(tag="t", epoch=2, examples_per_s=400.0,
+                    eval_accuracy=0.4)
+    assert [r["slo"] for r in logged] == ["throughput", "throughput",
+                                          "eval_accuracy"]
+    # Warmup epochs are exempt from the throughput floor (compile != slow).
+    eng.check_epoch(tag="t", epoch=0, examples_per_s=1.0, steady=False)
+    assert len(logged) == 3
+    import numpy as np
+    eng.check_scores("el2n", np.array([1.0, np.nan, np.inf, 2.0]))
+    assert logged[-1]["slo"] == "nonfinite_scores"
+    assert logged[-1]["value"] == 0.5
+    v = eng.verdict()
+    assert not v["ok"] and v["violations"] == 4
+
+
+def test_slo_engine_from_cfg_none_without_objectives(tmp_path):
+    cfg = load_config(None, [])
+    assert SloEngine.from_cfg(cfg) is None
+    cfg = load_config(None, ["obs.slo_throughput_floor=10"])
+    assert SloEngine.from_cfg(cfg) is not None
+
+
+def test_slo_config_validation():
+    for bad in ("obs.server_port=70000", "obs.slo_throughput_frac=1.5",
+                "obs.slo_nonfinite_frac=1.0", "obs.slo_heartbeat_stale_s=0",
+                "obs.slo_eval_accuracy_floor=2.0"):
+        with pytest.raises(ValueError):
+            load_config(None, [bad])
+
+
+# ------------------------------------------- acceptance: collapse -> exit 1
+
+
+@pytest.fixture(scope="module")
+def collapsed_run(tmp_path_factory, tiny_ds):
+    """A real CPU fit whose steady throughput is an injected collapse
+    relative to the trailing perf-ledger baseline (clean history at 1e9
+    ex/s, frac 0.5 -> floor 5e8 no CPU lane can meet)."""
+    tmp_path = tmp_path_factory.mktemp("slo")
+    ledger = tmp_path / "perf_history.jsonl"
+    # The baseline is shape-filtered (the sentry's grouping discipline):
+    # clean history of THIS run's geometry+backend at 1e9, plus a foreign-
+    # shape record at 1.0 that must never drag the floor down.
+    geometry = {"dataset": "synthetic", "arch": "tiny_cnn", "batch": 64,
+                "epochs": 3, "method": "el2n"}
+    with open(ledger, "w") as fh:
+        for _ in range(3):
+            fh.write(json.dumps({"kind": "perf_history", "backend": "cpu",
+                                 "geometry": geometry,
+                                 "examples_per_s": 1e9}) + "\n")
+        fh.write(json.dumps({"kind": "perf_history", "backend": "cpu",
+                             "geometry": dict(geometry, arch="resnet18"),
+                             "examples_per_s": 1.0}) + "\n")
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=3",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        f"obs.heartbeat_dir={tmp_path}/hb",
+        "obs.server_port=0", f"obs.perf_ledger={ledger}",
+        "obs.slo_throughput_frac=0.5",
+        "score.pretrain_epochs=0", "score.batch_size=64"])
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    train_ds, test_ds = tiny_ds
+    run_monitor = _load_tool("run_monitor")
+    live = {}
+    with ObsSession(cfg, logger=logger) as obs:
+        fit(cfg, train_ds, test_ds, logger=logger)
+        live["port"] = obs.server.port
+        live["verdict"] = obs.slo.verdict()
+        # run_monitor against the LIVE server, post-collapse.
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            live["rc"] = run_monitor.main(
+                ["--url", f"http://127.0.0.1:{obs.server.port}", "--once",
+                 "--json"])
+        live["json"] = json.loads(buf.getvalue())
+        summary = emit_run_summary(logger, wall_s=1.0, exit_class="ok",
+                                   registry=obs.registry)
+    logger.close()
+    return dict(cfg=cfg, tmp_path=tmp_path, live=live, summary=summary,
+                run_monitor=run_monitor)
+
+
+def test_collapse_emits_validated_slo_violation(collapsed_run):
+    path = collapsed_run["cfg"].obs.metrics_path
+    records = [json.loads(line) for line in open(path) if line.strip()]
+    viol = [r for r in records if r.get("kind") == "slo_violation"]
+    assert viol, "throughput collapse emitted no slo_violation record"
+    v = viol[0]
+    assert v["slo"] == "throughput"
+    assert v["threshold"] == pytest.approx(5e8)
+    assert v["value"] < v["threshold"]
+    assert v["baseline"] == pytest.approx(1e9)
+    assert v["epoch"] >= 1   # warmup epoch exempt
+    vm = _load_tool("validate_metrics")
+    problems = vm.validate_file(path, expect_terminal=True)
+    assert problems == [], problems
+
+
+def test_collapse_mirrored_into_flightrec_and_summary(collapsed_run):
+    # MetricsLogger mirrors every event into the ring pre-gate; the summary
+    # carries the final verdict.
+    s = collapsed_run["summary"]
+    assert s["slo"]["ok"] is False and s["slo"]["violations"] >= 1
+    assert s["slo"]["recent"][0]["slo"] == "throughput"
+    assert s["server_port"] == collapsed_run["live"]["port"]
+
+
+def test_run_monitor_live_exits_1_with_violation(collapsed_run):
+    live = collapsed_run["live"]
+    assert live["rc"] == 1
+    out = live["json"]
+    assert out["exit_code"] == 1
+    slo = out["healthz"]["slo"]
+    assert slo["violations"] >= 1
+    assert any(v["slo"] == "throughput" for v in slo["recent"])
+
+
+def test_run_monitor_dead_run_exits_1_from_stream(collapsed_run, capsys):
+    rm = collapsed_run["run_monitor"]
+    rc = rm.main(["--metrics", collapsed_run["cfg"].obs.metrics_path,
+                  "--once", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["exit_code"] == 1
+    assert any(v["slo"] == "throughput" for v in out["violations"])
+    assert out["run_summary"]["exit_class"] == "ok"
+
+
+def test_run_monitor_unreachable_exits_2(capsys):
+    rm = _load_tool("run_monitor")
+    rc = rm.main(["--url", "http://127.0.0.1:9", "--once", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and out["unreachable"]
+
+
+def test_run_monitor_dead_unterminated_stream_exits_2(tmp_path, capsys):
+    """A crashed run (no terminal run_summary) whose newest records — a
+    healthy-looking fleet_status included — are old must read dead (exit 2),
+    not healthy: recorded ages are as-of-write and get projected to now."""
+    rm = _load_tool("run_monitor")
+    path = tmp_path / "metrics.jsonl"
+    old = time.time() - 3600
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"ts": old, "kind": "epoch", "epoch": 4,
+                             "train_loss": 0.1}) + "\n")
+        fh.write(json.dumps({"ts": old, "kind": "fleet_status", "n_ranks": 2,
+                             "ranks": [], "stalest_rank": 0,
+                             "stalest_age_s": 0.3,
+                             "straggler_rank": None}) + "\n")
+    rc = rm.main(["--metrics", str(path), "--once", "--json",
+                  "--stale-after", "60"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and out["exit_code"] == 2
+    assert out["last_record_age_s"] > 60
+    assert out["fleet"]["as_of_record"] is True
+    assert out["fleet"]["stalest_age_s"] > 60   # projected, not as-written
+
+
+def test_run_monitor_stale_heartbeats_exit_2(tmp_path, capsys):
+    rm = _load_tool("run_monitor")
+    d = str(tmp_path / "hb")
+    _write_beat(d, 0, age_s=300.0, step=7)
+    rc = rm.main(["--heartbeat-dir", d, "--once", "--json",
+                  "--stale-after", "60"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert out["fleet"]["stalest_age_s"] > 60
+
+
+def test_run_monitor_renders_human_view(collapsed_run, capsys):
+    rm = collapsed_run["run_monitor"]
+    rc = rm.main(["--metrics", collapsed_run["cfg"].obs.metrics_path,
+                  "--once"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "COMPLETE" in out and "throughput" in out

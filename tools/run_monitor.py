@@ -1,0 +1,308 @@
+"""Live terminal view of a training run, from its embedded status server.
+
+Polls the ``obs/server.py`` endpoints of a running process and renders one
+compact status screen: progress (stage/seed/epoch/step), throughput, MFU,
+ETA, the health verdict with its reasons, the fleet view (straggler named),
+and the SLO state. When the server is unreachable — the run is dead, or was
+started without one — the monitor DEGRADES to the on-disk artifacts: the
+per-rank heartbeat files (``obs/heartbeat.py``) and the metrics JSONL, which
+answer the same questions one write behind.
+
+Usage::
+
+    python tools/run_monitor.py --port 8787                 # live, refreshing
+    python tools/run_monitor.py --url http://host:8787 --once --json
+    python tools/run_monitor.py --metrics metrics.jsonl \
+        --heartbeat-dir ./checkpoints_heartbeats --once     # dead-run mode
+
+CI exit contract (``--once``; pinned by tests/test_run_monitor.py)::
+
+    0  healthy — verdict ok, no SLO violations
+    1  SLO violated (or the run is degraded/critical for a non-staleness
+       reason): the run is alive but out of contract
+    2  unreachable or stale: no server AND no readable artifacts, heartbeats
+       past --stale-after with no terminal run_summary, or a critical
+       verdict (poison / fired watchdog) — the run needs an operator, not a
+       dashboard
+
+A finished run (its stream ends with the ``run_summary`` terminal event) is
+judged by its records, not by heartbeat age: 1 if it recorded SLO
+violations, else 0 — so the same command works as a post-run gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXIT_HEALTHY, EXIT_SLO, EXIT_UNREACHABLE = 0, 1, 2
+
+#: Heartbeat age past which a run with no terminal record counts as dead.
+DEFAULT_STALE_AFTER_S = 60.0
+
+
+def fetch_json(url: str, timeout: float = 2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def gather_live(base_url: str, timeout: float = 2.0) -> dict | None:
+    """/healthz + /status from a live server, or None when unreachable.
+    A 503 /healthz (critical verdict) still carries its JSON body — that is
+    a reachable, answering server, not an unreachable one."""
+    base = base_url.rstrip("/")
+    try:
+        try:
+            health = fetch_json(f"{base}/healthz", timeout)
+        except urllib.error.HTTPError as err:
+            health = json.load(err)   # 503 critical: body is the payload
+        status = fetch_json(f"{base}/status", timeout)
+    except Exception as exc:   # noqa: BLE001 — unreachable is a verdict, not a crash
+        return {"source": "server", "unreachable": True,
+                "error": f"{type(exc).__name__}: {exc}"[:200]}
+    return {"source": "server", "unreachable": False, "healthz": health,
+            "status": status}
+
+
+def tail_records(path: str, kinds: tuple[str, ...] | None = None,
+                 limit: int = 5000) -> list[dict]:
+    """The last ``limit`` JSONL records (optionally filtered by kind);
+    partial trailing lines tolerated like every stream consumer."""
+    records: list[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if kinds is None or rec.get("kind") in kinds:
+                    records.append(rec)
+                    del records[:-limit]
+    except OSError:
+        return []
+    return records
+
+
+def gather_files(metrics: str | None, heartbeat_dir: str | None,
+                 stale_after_s: float) -> dict:
+    """The dead-run view from on-disk artifacts: fleet from heartbeats,
+    progress/violations/terminal state from the metrics stream."""
+    out: dict = {"source": "files", "unreachable": False}
+    now = time.time()
+    if heartbeat_dir:
+        from data_diet_distributed_tpu.obs.fleet import fleet_view
+        view = fleet_view(heartbeat_dir, stale_budget_s=stale_after_s)
+        if view is not None:   # an empty/cleaned-up dir must not mask the
+            out["fleet"] = view   # stream's fleet_status fallback below
+    if metrics:
+        recs = tail_records(metrics, ("epoch", "run_summary", "slo_violation",
+                                      "fleet_status", "summary"))
+        ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
+        if ts:
+            # Liveness of the STREAM itself: a run with no terminal record
+            # whose newest line is old is dead, whatever that line said.
+            out["last_record_age_s"] = round(now - max(ts), 3)
+        epochs = [r for r in recs if r.get("kind") == "epoch"]
+        if epochs:
+            out["last_epoch"] = epochs[-1]
+        out["violations"] = [r for r in recs
+                             if r.get("kind") == "slo_violation"]
+        terminal = [r for r in recs if r.get("kind") == "run_summary"]
+        if terminal:
+            out["run_summary"] = terminal[-1]
+        fleet_recs = [r for r in recs if r.get("kind") == "fleet_status"]
+        if fleet_recs and out.get("fleet") is None:
+            # A recorded snapshot's ages are as-of-WRITE: project them to
+            # now, so a healthy-looking record from a dead run reads stale.
+            rec = dict(fleet_recs[-1])
+            offset = max(0.0, now - rec["ts"]) if "ts" in rec else 0.0
+            if isinstance(rec.get("stalest_age_s"), (int, float)):
+                rec["stalest_age_s"] = round(rec["stalest_age_s"] + offset, 3)
+            rec["as_of_record"] = True
+            out["fleet"] = rec
+    if out.get("fleet") is None and not metrics:
+        out["unreachable"] = True
+        out["error"] = "no server URL, no readable artifacts"
+    return out
+
+
+def decide_exit(info: dict, stale_after_s: float) -> int:
+    """The CI verdict (module docstring contract)."""
+    if info.get("unreachable"):
+        return EXIT_UNREACHABLE
+    if info["source"] == "server":
+        health = info.get("healthz") or {}
+        slo = health.get("slo") or {}
+        if health.get("status") == "critical":
+            return EXIT_UNREACHABLE
+        if slo.get("violations"):
+            return EXIT_SLO
+        hb = health.get("heartbeats") or {}
+        age = hb.get("stalest_age_s")
+        if age is not None and age > max(stale_after_s,
+                                         hb.get("budget_s") or 0):
+            return EXIT_UNREACHABLE
+        return EXIT_SLO if health.get("status") != "ok" else EXIT_HEALTHY
+    # files mode: a terminally-complete run is judged by its records; an
+    # unterminated one by heartbeat AND stream freshness (a fleet snapshot
+    # that looked healthy when written proves nothing hours later —
+    # gather_files already projects recorded ages to now).
+    if info.get("run_summary") is None:
+        fleet = info.get("fleet")
+        stream_age = info.get("last_record_age_s")
+        if fleet is None and stream_age is None:
+            return EXIT_UNREACHABLE
+        if fleet is not None and fleet.get("stalest_age_s", 0) > stale_after_s:
+            return EXIT_UNREACHABLE
+        if stream_age is not None and stream_age > stale_after_s:
+            return EXIT_UNREACHABLE
+    if info.get("violations"):
+        return EXIT_SLO
+    return EXIT_HEALTHY
+
+
+def _fmt(v, digits: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def render(info: dict) -> str:
+    lines: list[str] = []
+    if info.get("unreachable"):
+        return f"unreachable: {info.get('error', 'no source')}"
+    if info["source"] == "server":
+        st = info.get("status") or {}
+        h = info.get("healthz") or {}
+        prog = (f"epoch {_fmt(st.get('epoch'))}/"
+                f"{_fmt(st.get('total_epochs'))}"
+                f"  step {_fmt(st.get('step'))}")
+        lines.append(f"run: stage={st.get('stage') or '-'}"
+                     + (f" seed={st['seed']}" if st.get("seed") is not None
+                        else "")
+                     + f"  {prog}"
+                     f"  {_fmt(st.get('examples_per_s'))} ex/s"
+                     + (f"  mfu {st['mfu']:.3f}" if st.get("mfu") else "")
+                     + f"  eta {_fmt(st.get('eta_s'))}s")
+        verdict = h.get("status", "?")
+        reasons = "; ".join(h.get("reasons") or []) or "-"
+        lines.append(f"health: {verdict}  ({reasons})")
+        hb = h.get("heartbeats") or {}
+        if hb.get("ranks"):
+            lines.append(f"heartbeats: {hb['ranks']} rank(s), stalest "
+                         f"rank{hb.get('stalest_rank')} "
+                         f"{_fmt(hb.get('stalest_age_s'))}s "
+                         f"(budget {_fmt(hb.get('budget_s'))}s)")
+        slo = h.get("slo") or {}
+        lines.append(f"slo: {slo.get('violations', 0)} violation(s)")
+        for v in slo.get("recent") or []:
+            lines.append(f"  [{v.get('slo')}] value {v.get('value')} vs "
+                         f"threshold {v.get('threshold')}")
+        return "\n".join(lines)
+    # files mode
+    ep = info.get("last_epoch")
+    if ep:
+        lines.append(f"last epoch record: epoch {ep.get('epoch')}  "
+                     f"{_fmt(ep.get('examples_per_s'))} ex/s  "
+                     f"loss {_fmt(ep.get('train_loss'), 4)}")
+    rs = info.get("run_summary")
+    lines.append("run: " + (f"COMPLETE (exit_class={rs.get('exit_class')}, "
+                            f"wall {_fmt(rs.get('wall_s'))}s)" if rs
+                            else "no terminal record (dead or still running)"))
+    fleet = info.get("fleet")
+    if fleet:
+        lines.append(f"fleet: {fleet.get('n_ranks')} rank(s), stalest "
+                     f"rank{fleet.get('stalest_rank')} "
+                     f"{_fmt(fleet.get('stalest_age_s'))}s"
+                     + (f"  STRAGGLER {fleet.get('straggler_reason')}"
+                        if fleet.get("straggler_rank") is not None else ""))
+    viol = info.get("violations") or []
+    lines.append(f"slo: {len(viol)} violation record(s)")
+    for v in viol[-5:]:
+        lines.append(f"  [{v.get('slo')}] value {v.get('value')} vs "
+                     f"threshold {v.get('threshold')}")
+    return "\n".join(lines)
+
+
+def gather(args) -> dict:
+    url = args.url or (f"http://{args.host}:{args.port}" if args.port
+                       else None)
+    info = gather_live(url, args.timeout) if url else None
+    if info is not None and not info.get("unreachable"):
+        return info
+    if args.metrics or args.heartbeat_dir:
+        files = gather_files(args.metrics, args.heartbeat_dir,
+                             args.stale_after)
+        if info is not None:
+            files["server_error"] = info.get("error")
+        return files
+    return info if info is not None else {
+        "source": "none", "unreachable": True,
+        "error": "no --url/--port and no --metrics/--heartbeat-dir"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render a live (or post-mortem) view of a training run "
+                    "from its obs status server, degrading to heartbeat/"
+                    "metrics files")
+    parser.add_argument("--url", default=None,
+                        help="status-server base URL (http://host:port)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="status-server port on --host")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--metrics", default=None,
+                        help="metrics JSONL fallback for dead runs")
+    parser.add_argument("--heartbeat-dir", default=None,
+                        help="per-rank heartbeat directory fallback")
+    parser.add_argument("--once", action="store_true",
+                        help="one sample, then exit with the CI contract "
+                             "(0 healthy / 1 SLO violated / 2 unreachable-"
+                             "or-stale)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the gathered view as one JSON object")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh cadence without --once")
+    parser.add_argument("--timeout", type=float, default=2.0,
+                        help="per-request HTTP timeout")
+    parser.add_argument("--stale-after", type=float,
+                        default=DEFAULT_STALE_AFTER_S,
+                        help="heartbeat age past which an unterminated run "
+                             "counts as dead (exit 2)")
+    args = parser.parse_args(argv)
+
+    while True:
+        info = gather(args)
+        code = decide_exit(info, args.stale_after)
+        if args.json:
+            info["exit_code"] = code
+            print(json.dumps(info))
+        else:
+            print(render(info), flush=True)
+        if args.once:
+            return code
+        try:
+            time.sleep(args.interval)
+            if not args.json:
+                print("---", flush=True)
+        except KeyboardInterrupt:
+            return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,9 @@ KNOWN_KINDS = frozenset({
     # Score Observatory (obs/scoreboard.py + pruning provenance): per-seed
     # score distributions, cross-seed rank stability, prune decisions.
     "score_stats", "score_stability", "prune_decision",
+    # Live introspection layer (obs/server.py, obs/fleet.py, obs/slo.py):
+    # server lifecycle, cross-rank fleet snapshots, SLO violations.
+    "obs_server", "fleet_status", "slo_violation",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -76,6 +79,14 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
                         "overlap_at_keep"),
     "prune_decision": ("method", "sparsity", "n_total", "n_kept",
                        "kept_digest", "manifest"),
+    # Live introspection. Null-tolerant like xla_program: a fleet with no
+    # step-reporting heartbeats degrades max_step/straggler_rank to null, a
+    # violation's value may be null on a degenerate input — the KEYS must
+    # be present so consumers can rely on the shape.
+    "obs_server": ("event",),
+    "fleet_status": ("n_ranks", "ranks", "stalest_rank", "stalest_age_s",
+                     "straggler_rank"),
+    "slo_violation": ("slo", "value", "threshold"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
