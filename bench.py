@@ -152,7 +152,7 @@ def _append_ledger(line: dict) -> None:
                "source": "bench", "geometry": _LEDGER["geometry"]}
         for k in ("metric", "value", "unit", "vs_baseline", "error",
                   "exit_class", "chunk_steps", "mfu", "pass_s",
-                  "score_stability", "slo", "serve"):
+                  "score_stability", "slo", "serve", "comm"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -816,8 +816,25 @@ def bench_train(args, metric: str) -> None:
                  dispatches_per_sec=round(dispatches_per_epoch / mean_epoch_s,
                                           2),
                  epoch_s=summary["epoch_s"])
-    extra.update(_xla_extras(
-        "train_chunk" if res.chunk_steps > 1 else "train_step", per_sec))
+    program = "train_chunk" if res.chunk_steps > 1 else "train_step"
+    extra.update(_xla_extras(program, per_sec))
+    # Comm block: mesh geometry + analytic per-step collective bytes +
+    # overlap verdict + fetch wall (obs/comm.py — the same derivation the
+    # fit's comm_stats record carries), so the perf-sentry ledger can
+    # baseline overlap/traffic regressions next to throughput.
+    try:
+        from data_diet_distributed_tpu.obs import comm as obs_comm
+        from data_diet_distributed_tpu.parallel.mesh import \
+            resolve_update_sharding
+        comm = obs_comm.comm_block(
+            res.state.params, mesh,
+            resolve_update_sharding(cfg.mesh, mesh), program=program)
+        comm["mesh"] = {**{str(k): int(v) for k, v in mesh.shape.items()},
+                        "processes": jax.process_count()}
+        extra["comm"] = comm
+    except Exception as exc:   # noqa: BLE001 — comm block must not mask the number
+        print(f"[bench] comm block failed: {exc!r}", file=sys.stderr,
+              flush=True)
     emit(metric, round(per_chip, 1), "examples/sec/chip",
          round(per_chip / TRAIN_BUDGET_PER_CHIP, 4), **extra)
 

@@ -1,4 +1,4 @@
-"""Orbax checkpointing: ONE schema, true resume.
+"""Orbax checkpointing: ONE schema, true resume — plus the async LOCAL tier.
 
 The reference has two incompatible ad-hoc ``torch.save`` schemas (``{'net','acc','epoch'}``
 at ``trainer/trainer.py:64-71`` vs ``{'model_state_dict',...}`` at ``ddp.py:116-123``),
@@ -8,31 +8,454 @@ never restored — SURVEY §5.4). Here every checkpoint is the full
 multi-host safe (Orbax coordinates processes internally), retention-limited, and the
 scoring phase can load any step's params — the ``score_ckpt_step`` knob replacing the
 reference's hard-coded ``ckpt_19.pth`` (``train.py:61``).
+
+MULTI-TIER (``checkpoint.local_tier``, ``LocalTier``): at pod scale the
+durable filesystem is the step-stall — even async Orbax pays a
+previous-save barrier plus a coordinated commit on shared storage. The
+local tier makes the SAVE a rank-local fast path: each rank writes only the
+leaf shards it OWNS (``replica_id == 0`` — params once across the fleet
+under the sharded update, slots per-rank) to LOCAL disk with a per-rank
+digest manifest, and a background thread PROMOTES completed saves to the
+durable tier (``<dir>_tiered/``), re-verifying digests after the copy. A
+step only counts as restorable once every rank's shards are promoted and
+verified — so consensus restore (``verified_steps``) can never agree on a
+half-promoted step — and the preemption path drains in-flight promotions
+before the agreed exit 75 (``all_steps`` is the durability barrier, as
+before). Readers need no config: tier steps are discovered from the path
+convention, so any later run restores them like Orbax steps.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
+import threading
+import time
 from typing import TYPE_CHECKING, Any
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from .obs import registry as obs_registry
 from .obs import tracing
 from .resilience.integrity import (CheckpointCorrupt, build_manifest,
                                    verify_restored)
+from .utils.io import atomic_write_json
 
 if TYPE_CHECKING:  # avoid a circular import (train.loop uses this module)
     from .train.state import TrainState
 
 
+def tiered_dir(directory: str) -> str:
+    """The durable-tier path convention (sibling of the Orbax dir, like
+    ``_stages.json``/``_sidechannel``): readers discover promoted tier steps
+    here with no config."""
+    return f"{os.path.abspath(directory)}_tiered"
+
+
+def local_tier_dir(directory: str, configured: str | None = None) -> str:
+    """The fast local-tier scratch root (point ``checkpoint.local_dir`` at
+    genuinely local disk on real pods).
+
+    A configured root is NAMESPACED by the checkpoint directory's identity
+    (basename + path hash): operators point every job on a host at the same
+    local SSD, and without the namespace two concurrent runs would collide
+    on ``rank<k>/step_<n>`` — run A's promoter could then copy run B's
+    freshly-replaced shards into A's durable tier with PASSING digests (the
+    manifest and npz would both be B's)."""
+    directory = os.path.abspath(directory)
+    if configured is None:
+        return f"{directory}_local"
+    slug = (f"{os.path.basename(directory)}-"
+            f"{hashlib.sha256(directory.encode()).hexdigest()[:10]}")
+    return os.path.join(os.path.abspath(configured), slug)
+
+
+def _sha(data: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()[:16]
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{int(step)}")
+
+
+def _payload_of(state: "TrainState") -> dict[str, Any]:
+    return {"params": state.params, "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state, "step": state.step}
+
+
+def _owned_shards(leaf) -> list[tuple[tuple, np.ndarray]]:
+    """The ``(global_index, host_data)`` pieces of ``leaf`` THIS process
+    owns. Ownership is ``replica_id == 0``: for sharded leaves every local
+    shard owns its slice; for replicated leaves exactly one device in the
+    fleet owns the whole — so the union over ranks covers every leaf exactly
+    once, which is what makes the per-rank save a SHARDED save instead of a
+    world-times-duplicated one. Non-jax leaves (host scalars) are owned by
+    rank 0."""
+    if not hasattr(leaf, "addressable_shards"):
+        if jax.process_index() == 0:
+            return [((), np.asarray(leaf))]
+        return []
+    out = []
+    for sh in leaf.addressable_shards:
+        if sh.replica_id != 0:
+            continue
+        out.append((sh.index, np.asarray(sh.data)))
+    return out
+
+
+def _index_json(index: tuple, shape: tuple) -> list[list[int]] | None:
+    """A shard's global index as JSON (``[[start, stop], ...]`` per dim);
+    None = the whole leaf."""
+    if not index:
+        return None
+    out = []
+    for sl, dim in zip(index, shape):
+        out.append([int(sl.start or 0),
+                    int(dim if sl.stop is None else sl.stop)])
+    return out
+
+
+def tier_steps(directory: str) -> list[int]:
+    """Steps fully promoted to the durable tier: every rank named by the
+    rank-0 marker has its own promotion marker present. Sorted ascending."""
+    root = tiered_dir(directory)
+    out = []
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue
+        sdir = os.path.join(root, name)
+        try:
+            with open(os.path.join(sdir, "promoted.rank0.json")) as fh:
+                world = int(json.load(fh).get("world", 1))
+        except (OSError, ValueError):
+            continue
+        if all(os.path.exists(os.path.join(sdir, f"promoted.rank{r}.json"))
+               for r in range(world)):
+            out.append(step)
+    return sorted(out)
+
+
+class LocalTier:
+    """Per-rank local-disk saves + background promotion to the durable tier.
+
+    ``save_local`` is the fast path the step loop pays: owned-shard
+    device→host fetch, one npz + digest manifest to local disk, enqueue.
+    The promoter thread copies each completed save to
+    ``tiered_dir(directory)``, re-loads the copy to verify every digest,
+    then writes this rank's atomic ``promoted.rank<k>.json`` marker — the
+    durable commit point. ``drain`` (the preemption path, via
+    ``CheckpointManager.all_steps``) bounds the wait on in-flight
+    promotions. Promotion errors are logged (``{"kind": "ckpt_tier",
+    "tier": "error"}``) and surfaced on drain — never raised from the
+    background thread into nowhere."""
+
+    def __init__(self, directory: str, *, local_dir: str | None = None,
+                 promote: bool = True, drain_timeout_s: float = 120.0,
+                 promote_delay_s: float = 0.0, max_to_keep: int = 20,
+                 logger=None):
+        self.rank = jax.process_index()
+        self.world = jax.process_count()
+        self.durable_root = tiered_dir(directory)
+        self.local_root = os.path.join(
+            local_tier_dir(directory, local_dir), f"rank{self.rank}")
+        self.promote = promote
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.promote_delay_s = float(promote_delay_s)
+        self.max_to_keep = int(max_to_keep)
+        self.logger = logger
+        self.errors: list[str] = []
+        self._pending: list[int] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        os.makedirs(self.local_root, exist_ok=True)
+        os.makedirs(self.durable_root, exist_ok=True)
+
+    # ------------------------------------------------------------ fast path
+
+    def save_local(self, step: int, state: "TrainState",
+                   metrics: dict[str, Any] | None = None) -> None:
+        """The step loop's save: owned shards → local disk, then enqueue the
+        promotion. Rank 0's manifest additionally carries the STATE-level
+        integrity manifest (``resilience/integrity.build_manifest`` — the
+        same table the Orbax composite rides) and the epoch-metadata
+        ``metrics`` dict resume reads."""
+        t0 = time.perf_counter()
+        payload = _payload_of(state)
+        sdir = _step_dir(self.local_root, step)
+        os.makedirs(sdir, exist_ok=True)
+        arrays: dict[str, np.ndarray] = {}
+        entries = []
+        leaves_meta: dict[str, dict] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(payload)[0]:
+            keystr = jax.tree_util.keystr(path)
+            leaves_meta[keystr] = {
+                "shape": [int(d) for d in getattr(leaf, "shape", ())],
+                "dtype": str(getattr(leaf, "dtype", "int64")),
+            }
+            for i, (index, data) in enumerate(_owned_shards(leaf)):
+                key = f"a{len(arrays)}"
+                arrays[key] = data
+                entries.append({
+                    "key": key, "leaf": keystr,
+                    "index": _index_json(index, getattr(leaf, "shape", ())),
+                    "sha": _sha(data),
+                })
+        manifest: dict[str, Any] = {
+            "version": 1, "step": int(step), "rank": self.rank,
+            "world": self.world, "arrays": entries, "leaves": leaves_meta,
+        }
+        # EVERY rank computes the state manifest: its finiteness check is a
+        # device reduction, which over data-axis-SHARDED params (the sharded
+        # weight update) is a cross-process program every rank must launch —
+        # a rank-0-only dispatch would deadlock the pod on the first tier
+        # save. Only rank 0 persists the result (one copy is the contract).
+        state_manifest = build_manifest(payload, step)
+        if self.rank == 0:
+            manifest["state_manifest"] = state_manifest
+            if metrics:
+                manifest["metrics"] = metrics
+        # Atomic npz (temp + rename, same discipline as utils.io) — a kill
+        # mid-save must never leave a truncated shard file a promotion
+        # could trust.
+        tmp = os.path.join(sdir, "shards.tmp.npz")
+        np.savez(tmp, **arrays)
+        os.replace(tmp, os.path.join(sdir, "shards.npz"))
+        atomic_write_json(os.path.join(sdir, "manifest.json"), manifest)
+        self._log(step, "local", wall_s=round(time.perf_counter() - t0, 4),
+                  n_arrays=len(arrays))
+        if self.promote:
+            with self._cond:
+                self._pending.append(int(step))
+                self._cond.notify_all()
+            self._ensure_thread()
+
+    # ------------------------------------------------------------ promotion
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._worker, daemon=True,
+                                            name="ckpt-tier-promoter")
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait(0.2)
+                if self._stop and not self._pending:
+                    return
+                step = self._pending[0]
+            try:
+                if self.promote_delay_s:
+                    time.sleep(self.promote_delay_s)
+                self._promote(step)
+            except Exception as exc:   # noqa: BLE001 — surfaced, never lost
+                self.errors.append(f"step {step}: {exc!r}"[:300])
+                self._log(step, "error", error=repr(exc)[:300])
+            finally:
+                with self._cond:
+                    self._pending.remove(step)
+                    self._cond.notify_all()
+
+    def _promote(self, step: int) -> None:
+        t0 = time.perf_counter()
+        src = _step_dir(self.local_root, step)
+        dst = _step_dir(self.durable_root, step)
+        os.makedirs(dst, exist_ok=True)
+        with open(os.path.join(src, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        for name, out in (("shards.npz", f"rank{self.rank}.npz"),
+                          ("manifest.json", f"rank{self.rank}.manifest.json")):
+            tmp = os.path.join(dst, f".{out}.tmp")
+            shutil.copyfile(os.path.join(src, name), tmp)
+            os.replace(tmp, os.path.join(dst, out))
+        # Verify the DURABLE copy against the save-time digests before the
+        # marker makes it count: a torn/bit-flipped copy must stay invisible
+        # to restore and consensus.
+        with np.load(os.path.join(dst, f"rank{self.rank}.npz"),
+                     allow_pickle=False) as d:
+            for entry in manifest["arrays"]:
+                got = _sha(d[entry["key"]])
+                if got != entry["sha"]:
+                    raise CheckpointCorrupt(
+                        f"tier promotion of step {step}: array "
+                        f"{entry['leaf']} digest {got} != saved "
+                        f"{entry['sha']}")
+        atomic_write_json(
+            os.path.join(dst, f"promoted.rank{self.rank}.json"),
+            {"step": int(step), "rank": self.rank, "world": self.world,
+             "ts": round(time.time(), 3)})
+        # The local copy is scratch; promoted = safe to reclaim.
+        shutil.rmtree(src, ignore_errors=True)
+        self._log(step, "durable",
+                  wall_s=round(time.perf_counter() - t0, 4))
+        self._retain()
+
+    def _retain(self) -> None:
+        """Bounded durable-tier retention: each rank prunes ITS files (and
+        marker) for steps beyond ``max_to_keep``; the directory disappears
+        when the last rank's prune empties it."""
+        steps = []
+        try:
+            for name in os.listdir(self.durable_root):
+                if name.startswith("step_"):
+                    try:
+                        steps.append(int(name[len("step_"):]))
+                    except ValueError:
+                        pass
+        except FileNotFoundError:
+            return
+        for step in sorted(steps)[:-self.max_to_keep] if len(
+                steps) > self.max_to_keep else []:
+            sdir = _step_dir(self.durable_root, step)
+            for name in (f"promoted.rank{self.rank}.json",
+                         f"rank{self.rank}.npz",
+                         f"rank{self.rank}.manifest.json"):
+                try:
+                    os.remove(os.path.join(sdir, name))
+                except FileNotFoundError:
+                    pass
+            try:
+                os.rmdir(sdir)
+            except OSError:
+                pass   # other ranks' files remain — theirs to prune
+
+    # ------------------------------------------------------------- control
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Block until every enqueued promotion has finished (the durability
+        barrier the preemption path rides). Returns False on timeout OR when
+        any promotion has FAILED (``self.errors`` — each failure also logged
+        as a ``ckpt_tier`` error record at fire time). Either way the real
+        durability contract is the step LISTING: a step whose promotion
+        failed never appears in ``tier_steps``/``all_steps``, so restore and
+        consensus can never trust it."""
+        budget = self.drain_timeout_s if timeout_s is None else timeout_s
+        with self._cond:
+            ok = self._cond.wait_for(lambda: not self._pending, budget)
+        if not ok:
+            self._log(-1, "error",
+                      error=f"drain timed out after {budget}s with "
+                            f"{len(self._pending)} promotion(s) in flight")
+        return ok and not self.errors
+
+    def close(self) -> None:
+        self.drain()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _log(self, step: int, tier: str, **fields) -> None:
+        obs_registry.inc(f"ckpt_tier_{tier}")
+        if self.logger is not None:
+            self.logger.log("ckpt_tier", step=int(step), tier=tier,
+                            rank=self.rank, **fields)
+
+
+def _read_tier_manifests(directory: str, step: int) -> list[dict]:
+    sdir = _step_dir(tiered_dir(directory), step)
+    out = []
+    with open(os.path.join(sdir, "promoted.rank0.json")) as fh:
+        world = int(json.load(fh).get("world", 1))
+    for r in range(world):
+        with open(os.path.join(sdir, f"rank{r}.manifest.json")) as fh:
+            out.append(json.load(fh))
+    return out
+
+
+def restore_tier_payload(directory: str, step: int) -> dict[str, Any]:
+    """Assemble the full host payload ``{leaf_keystr: np.ndarray}`` for a
+    promoted tier step from every rank's shard files, digest-verifying each
+    array as it is read."""
+    sdir = _step_dir(tiered_dir(directory), step)
+    manifests = _read_tier_manifests(directory, step)
+    leaves: dict[str, np.ndarray] = {}
+    meta = manifests[0]["leaves"]
+    for m in manifests:
+        meta.update(m["leaves"])
+    for key, info in meta.items():
+        leaves[key] = np.zeros(tuple(info["shape"]), np.dtype(info["dtype"]))
+    for m in manifests:
+        with np.load(os.path.join(sdir, f"rank{m['rank']}.npz"),
+                     allow_pickle=False) as d:
+            for entry in m["arrays"]:
+                data = d[entry["key"]]
+                if _sha(data) != entry["sha"]:
+                    raise CheckpointCorrupt(
+                        f"tier step {step}: array {entry['leaf']} (rank "
+                        f"{m['rank']}) failed digest verification")
+                if entry["index"] is None:
+                    leaves[entry["leaf"]] = data.reshape(
+                        leaves[entry["leaf"]].shape)
+                else:
+                    sl = tuple(slice(s, e) for s, e in entry["index"])
+                    leaves[entry["leaf"]][sl] = data
+    return leaves
+
+
+def tier_map(directory: str, local_dir: str | None = None) -> dict[str, str]:
+    """``{step: tier}`` for every checkpoint under ``directory`` — the
+    provenance block the stage manifest records (``"durable"`` = promoted
+    tier step, ``"local"`` = saved but never promoted (rank-0 view),
+    ``"orbax"`` = classic composite). ``local_dir``: the configured
+    ``checkpoint.local_dir`` when one is set — the "local" scan must look
+    where the saves actually went."""
+    out: dict[str, str] = {}
+    try:
+        mngr = ocp.CheckpointManager(os.path.abspath(directory))
+        for s in mngr.all_steps():
+            out[str(int(s))] = "orbax"
+        mngr.close()
+    except Exception:   # noqa: BLE001 — absent/foreign dir: no orbax steps
+        pass
+    for s in tier_steps(directory):
+        out[str(int(s))] = "durable"
+    local_root = os.path.join(local_tier_dir(directory, local_dir), "rank0")
+    try:
+        for name in os.listdir(local_root):
+            if name.startswith("step_"):
+                out.setdefault(name[len("step_"):], "local")
+    except FileNotFoundError:
+        pass
+    return out
+
+
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 20):
+    def __init__(self, directory: str, max_to_keep: int = 20,
+                 tier=None, logger=None):
+        """``tier`` (a ``config.CheckpointConfig`` with ``local_tier=True``,
+        or None) arms the multi-tier WRITE path: saves go through
+        ``LocalTier`` (fast per-rank local save + background promotion)
+        instead of the Orbax composite. READERS never need it — promoted
+        tier steps are discovered from the path convention and served by
+        ``all_steps``/``restore``/``manifest``/``metrics`` transparently,
+        next to any Orbax steps in the same directory."""
         directory = os.path.abspath(directory)
         self.directory = directory
         if jax.process_index() == 0:
             os.makedirs(directory, exist_ok=True)
+        self._tier: LocalTier | None = None
+        if tier is not None and getattr(tier, "local_tier", False):
+            self._tier = LocalTier(
+                directory, local_dir=tier.local_dir, promote=tier.promote,
+                drain_timeout_s=tier.drain_timeout_s,
+                promote_delay_s=tier.promote_delay_s,
+                max_to_keep=max_to_keep, logger=logger)
         self._mngr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -42,6 +465,16 @@ class CheckpointManager:
 
     def save(self, step: int, state: "TrainState",
              metrics: dict[str, Any] | None = None) -> None:
+        if self._tier is not None:
+            # The multi-tier fast path: rank-local shard write + background
+            # promotion — the step loop never waits on durable storage. The
+            # span measures the LOCAL write, which is the stall actually
+            # paid (the promotion wall rides the ckpt_tier records).
+            with tracing.span("checkpoint_save", cat="checkpoint",
+                              step=step, tier="local"), \
+                    obs_registry.timed("checkpoint_save_s"):
+                self._tier.save_local(step, state, metrics)
+            return
         payload = {"params": state.params, "batch_stats": state.batch_stats,
                    "opt_state": state.opt_state, "step": state.step}
         composite = {"state": ocp.args.StandardSave(payload),
@@ -78,21 +511,32 @@ class CheckpointManager:
             self._mngr.save(step, args=ocp.args.Composite(**composite),
                             force=True)
 
+    def _tier_steps(self) -> list[int]:
+        return tier_steps(self.directory)
+
     def latest_step(self) -> int | None:
-        self._mngr.wait_until_finished()
-        return self._mngr.latest_step()
+        steps = self.all_steps()
+        return max(steps) if steps else None
 
     def all_steps(self) -> list[int]:
+        # Durability barrier, both tiers: in-flight async Orbax saves land,
+        # in-flight tier promotions drain — the preemption path calls this
+        # before claiming a durable step.
+        if self._tier is not None:
+            self._tier.drain()
         self._mngr.wait_until_finished()
-        return list(self._mngr.all_steps())
+        return sorted(set(self._mngr.all_steps()) | set(self._tier_steps()))
 
     def restore(self, state: "TrainState", step: int | None = None) -> "TrainState":
         """Restore into (the abstract shape of) ``state`` — exact resume including
-        optimizer state and step counter."""
+        optimizer state and step counter. Tier steps (promoted shard files)
+        and Orbax composites are served transparently from the same call."""
         self._mngr.wait_until_finished()   # an in-flight async save may be it
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoint to restore")
+        if int(step) in self._tier_steps():
+            return self._restore_tier(state, int(step))
         template = {"params": state.params, "batch_stats": state.batch_stats,
                     "opt_state": state.opt_state, "step": state.step}
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
@@ -107,9 +551,44 @@ class CheckpointManager:
                              opt_state=payload["opt_state"],
                              step=payload["step"])
 
+    def _restore_tier(self, state: "TrainState", step: int) -> "TrainState":
+        """Assemble a promoted tier step (digest-verified per array) and
+        place it with the TEMPLATE's shardings — the tier twin of Orbax's
+        StandardRestore(abstract)."""
+        from .parallel.mesh import _device_put
+        with tracing.span("checkpoint_restore", cat="checkpoint", step=step,
+                          tier="durable"), \
+                obs_registry.timed("checkpoint_restore_s"):
+            leaves = restore_tier_payload(self.directory, step)
+            template = _payload_of(state)
+            flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+            out = []
+            for path, leaf in flat:
+                key = jax.tree_util.keystr(path)
+                if key not in leaves:
+                    raise CheckpointCorrupt(
+                        f"tier step {step}: leaf {key} missing from the "
+                        "promoted shard files — incompatible state tree")
+                value = leaves[key]
+                if hasattr(leaf, "sharding"):
+                    out.append(_device_put(
+                        np.asarray(value, dtype=leaf.dtype), leaf.sharding))
+                elif isinstance(leaf, (int, np.integer)):
+                    out.append(int(value))
+                else:
+                    out.append(np.asarray(value))
+            payload = jax.tree_util.tree_unflatten(treedef, out)
+        return state.replace(params=payload["params"],
+                             batch_stats=payload["batch_stats"],
+                             opt_state=payload["opt_state"],
+                             step=payload["step"])
+
     def manifest(self, step: int) -> dict[str, Any] | None:
         """The integrity manifest saved alongside a step (None for checkpoints
         written before manifests existed — those stay restorable unverified)."""
+        if int(step) in self._tier_steps():
+            manifests = _read_tier_manifests(self.directory, int(step))
+            return manifests[0].get("state_manifest")
         self._mngr.wait_until_finished()
         try:
             restored = self._mngr.restore(
@@ -197,6 +676,9 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
+        if int(step) in self._tier_steps():
+            manifests = _read_tier_manifests(self.directory, int(step))
+            return manifests[0].get("metrics")
         try:
             restored = self._mngr.restore(
                 step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
@@ -211,5 +693,7 @@ class CheckpointManager:
         return {"params": restored.params, "batch_stats": restored.batch_stats}
 
     def close(self) -> None:
+        if self._tier is not None:
+            self._tier.close()
         self._mngr.wait_until_finished()
         self._mngr.close()

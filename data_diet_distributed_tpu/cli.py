@@ -64,6 +64,18 @@ def main(argv: list[str] | None = None) -> int:
         if "error" in info:
             print(f"[resilience] {info['error']}", file=sys.stderr, flush=True)
             return EXIT_RETRIABLE   # EX_UNAVAILABLE: wedged before any claim
+    # Comm/compute overlap flags (parallel.overlap) must land in XLA_FLAGS
+    # BEFORE the backend initializes — i.e. right here, ahead of multihost
+    # init. Auto mode is silent on non-TPU lanes; an explicit enable that
+    # cannot engage (wrong backend, backend already up) warns once.
+    from .parallel.overlap import apply_overlap_flags
+    flags, overlap_reason = apply_overlap_flags(cfg)
+    if overlap_reason is None:
+        print(f"[overlap] XLA overlap flags armed: {' '.join(flags)}",
+              flush=True)
+    elif cfg.parallel.overlap.enabled:
+        print(f"[overlap] overlap cannot engage: {overlap_reason}",
+              file=sys.stderr, flush=True)
     from .parallel.mesh import initialize_multihost
     initialize_multihost(cfg.mesh)
 

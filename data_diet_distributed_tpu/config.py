@@ -196,11 +196,76 @@ class MeshConfig:
     # it trades one all-gather per step for optimizer memory, which only pays
     # once params are a meaningful fraction of HBM.
     shard_opt_state: bool = False
+    # Cross-replica SHARDED WEIGHT UPDATE (arXiv 2004.13336, the ZeRO-on-TPU
+    # recipe): params AND optimizer slots live data-axis sharded between
+    # steps, gradients reduce-SCATTER (not all-reduce) onto the data axis,
+    # each replica updates only its parameter shard, and the forward
+    # all-gathers weights at use — where the latency-hiding scheduler can
+    # overlap both collectives against compute (parallel.overlap). Implies
+    # shard_opt_state. Bit-identical to the replicated update on CPU meshes
+    # (pinned); None = auto: armed by DDT_SHARDED_UPDATE=1 pending the
+    # on-chip bisection, like the GraNd megakernel gate.
+    shard_weight_update: bool | None = None
     # Multi-host: call jax.distributed.initialize() before device queries.
     multihost: bool = False
     coordinator_address: str | None = None
     num_processes: int | None = None
     process_id: int | None = None
+
+
+@dataclass
+class OverlapConfig:
+    """XLA latency-hiding / async-collective flags (``parallel/overlap.py``)
+    that let the compiler overlap the sharded update's reduce-scatter and
+    weight all-gather against backward/forward compute.
+
+    Flags go into ``XLA_FLAGS`` and must land BEFORE backend init (the CLI
+    applies them right before ``initialize_multihost``); they are TPU-backend
+    flags, so ``enabled=None`` (auto) applies them only when the target
+    backend is TPU — on CPU lanes, or once a backend is already initialized,
+    overlap cannot engage and the apply degrades to a no-op with one
+    warning."""
+
+    enabled: bool | None = None      # None = auto: TPU backends only
+    latency_hiding_scheduler: bool = True
+    async_all_gather: bool = True
+    async_reduce_scatter: bool = True
+    async_all_reduce: bool = True
+    async_collective_permute: bool = True
+    # Extra raw XLA flags appended verbatim (operator escape hatch).
+    extra_flags: tuple[str, ...] = ()
+
+
+@dataclass
+class ParallelConfig:
+    """Communication-layer knobs that are not mesh GEOMETRY (which stays in
+    ``mesh``): today, the comm/compute overlap block."""
+
+    overlap: OverlapConfig = field(default_factory=OverlapConfig)
+
+
+@dataclass
+class CheckpointConfig:
+    """Multi-tier checkpointing (``checkpoint.py`` LocalTier): a fast
+    per-rank LOCAL-disk save at step cadence, promoted to the durable tier
+    by a background thread with digest verification — pod-scale state never
+    stalls the step on durable-storage latency. The durable tier
+    (``<train.checkpoint_dir>_tiered``) is what restore/consensus trust; a
+    step counts as restorable only once EVERY rank's shard is promoted and
+    digest-verified. Preemption drains in-flight promotions before exit 75."""
+
+    local_tier: bool = False
+    # Per-rank local (fast) tier ROOT; None -> <checkpoint_dir>_local.
+    # Point it at genuinely local disk on real pods — it is namespaced by
+    # the checkpoint directory's identity (checkpoint.local_tier_dir), so
+    # every job on a host may share one configured root without their
+    # scratch steps colliding.
+    local_dir: str | None = None
+    promote: bool = True             # background promotion to the durable tier
+    drain_timeout_s: float = 120.0   # preemption-path bound on the drain
+    # Artificial promotion delay (seconds) — test/ops hook so drills can pin
+    # a SIGTERM landing while a save is in flight.
+    promote_delay_s: float = 0.0
 
 
 @dataclass
@@ -385,6 +450,8 @@ class Config:
     prune: PruneConfig = field(default_factory=PruneConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
@@ -470,6 +537,15 @@ class Config:
             raise ValueError(
                 f"resilience.consensus_grace_s must be > 0, got "
                 f"{r.consensus_grace_s}")
+        c = self.checkpoint
+        if c.drain_timeout_s <= 0:
+            raise ValueError(
+                f"checkpoint.drain_timeout_s must be > 0, got "
+                f"{c.drain_timeout_s}")
+        if c.promote_delay_s < 0:
+            raise ValueError(
+                f"checkpoint.promote_delay_s must be >= 0, got "
+                f"{c.promote_delay_s}")
         o = self.obs
         if o.snapshot_every_s < 0:
             raise ValueError(
@@ -544,8 +620,9 @@ def _from_dict(cls, d: dict[str, Any]):
 _TYPE_MAP = {
     "DataConfig": DataConfig, "ModelConfig": ModelConfig, "OptimConfig": OptimConfig,
     "ScoreConfig": ScoreConfig, "PruneConfig": PruneConfig, "TrainConfig": TrainConfig,
-    "MeshConfig": MeshConfig, "ObsConfig": ObsConfig,
-    "ResilienceConfig": ResilienceConfig,
+    "MeshConfig": MeshConfig, "OverlapConfig": OverlapConfig,
+    "ParallelConfig": ParallelConfig, "CheckpointConfig": CheckpointConfig,
+    "ObsConfig": ObsConfig, "ResilienceConfig": ResilienceConfig,
 }
 
 

@@ -130,6 +130,12 @@ class MetricsRegistry:
         with self._lock:
             return self._histograms.setdefault(name, Histogram())
 
+    def peek_histogram(self, name: str) -> Histogram | None:
+        """The named histogram IF it accumulated — never creates (readers
+        like the comm block must not mint empty instruments)."""
+        with self._lock:
+            return self._histograms.get(name)
+
     @contextlib.contextmanager
     def timed(self, name: str):
         t0 = time.perf_counter()

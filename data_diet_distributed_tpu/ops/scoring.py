@@ -6,10 +6,19 @@ shard of every batch, and scores land in a host array joined by global example i
 Multi-seed averaging (the paper scores with ~10 independently-trained checkpoints and
 averages; the reference supports a single seed only) is a mean over per-seed passes that
 reuses the same compiled step — one compilation, ``n_seeds`` executions.
+
+Multi-process fetch engine: the default STREAM fetch DMAs only this rank's
+score shards to host per flush (overlapped with the next window's dispatch)
+and joins ranks with one sliced cross-process sum per seed — the full
+``[N]`` vector never round-trips whole through every process per flush (the
+legacy behavior, kept behind ``DDT_SCORE_FETCH=allgather`` and pinned
+identical by the 2-process drill). Measured −71 % fetch wall on the
+2-process CPU lane (PERFORMANCE.md "Pod-scale comm layer").
 """
 
 from __future__ import annotations
 
+import os
 from collections.abc import Sequence
 
 import jax
@@ -18,6 +27,7 @@ import numpy as np
 from ..data.datasets import ArrayDataset, make_position_joiner
 from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              num_batches)
+from ..obs import registry as obs_registry
 from ..obs import scoreboard as obs_scoreboard
 from .scores import make_score_chunk, make_score_step
 
@@ -47,14 +57,77 @@ def resolve_score_chunk_steps(chunk_steps: int | None, n_batches: int,
 
 
 def _to_host(batched: list[jax.Array]) -> list[np.ndarray]:
-    """Fetch (possibly multi-host sharded) device arrays to every host — one
-    call for the whole dataset pass, so device compute is never serialized
-    against per-batch host transfers (dispatch stays fully async)."""
-    if jax.process_count() > 1:
+    """Fetch device arrays to every host — one call for the whole flush
+    window, so device compute is never serialized against per-batch host
+    transfers (dispatch stays fully async).
+
+    The collective (``process_allgather``) runs only when an array is
+    actually NOT fully addressable from this process: fully-addressable
+    arrays — every single-host run, multi-device included, and any
+    mesh-local array under a multi-process runtime — take the plain
+    ``jax.device_get``, which is a local DMA, not a collective. (The old
+    guard keyed on ``process_count`` alone, which was correct by accident
+    for the single-host case; addressability is the property that actually
+    decides.)"""
+    if (jax.process_count() > 1
+            and not all(a.is_fully_addressable for a in batched)):
         from jax.experimental import multihost_utils
         return [np.asarray(a) for a in
                 multihost_utils.process_allgather(batched, tiled=True)]
     return [np.asarray(a) for a in jax.device_get(batched)]
+
+
+def resolve_fetch_mode() -> str:
+    """The multi-process score-fetch engine: ``"stream"`` (default — each
+    rank fetches only its local shards, one cross-process sum per seed) or
+    ``"allgather"`` (the legacy full-``[N]``-on-every-rank per-flush
+    collective), from ``DDT_SCORE_FETCH``. The two are pinned identical by
+    the 2-process drill; the env knob exists for that A/B and as the
+    rollback lever."""
+    mode = os.environ.get("DDT_SCORE_FETCH", "stream").lower()
+    return "allgather" if mode == "allgather" else "stream"
+
+
+def _local_shard_rows(arr: jax.Array) -> list[tuple[slice, np.ndarray]]:
+    """This process's OWNED row-slices of a 1-D batch-sharded score array,
+    as ``(global_rows, host_data)`` pairs — a rank-local device→host DMA,
+    no collective anywhere. Ownership = ``replica_id == 0``: for a sharded
+    array every addressable shard owns its rows; for a (degenerate)
+    replicated array exactly one replica owns each row globally, so the
+    per-seed cross-process sum can never double-count."""
+    out = []
+    n = arr.shape[0]
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        rows = shard.index[0] if shard.index else slice(None)
+        rows = slice(rows.start or 0, n if rows.stop is None else rows.stop)
+        out.append((rows, np.asarray(shard.data)))
+    return out
+
+
+#: Elements per cross-process combine slice: bounds the [world, slice] host
+#: buffer the per-seed sum materializes (1M f64 x world ranks ≈ 8 MB/rank
+#: per slice — a 1.2M-score pod pass streams in two slices).
+_COMBINE_SLICE_ELEMS = 1 << 20
+
+
+def _sum_across_processes(vec: np.ndarray) -> np.ndarray:
+    """Sum per-rank partial score vectors into the full ``[N]`` on every
+    rank — ONE sliced collective per seed (vs the legacy path's full-vector
+    allgather per FLUSH). Each position is owned by exactly one rank
+    (``_local_shard_rows``), so the sum adds a value to zeros — bit-exact
+    regardless of rank order."""
+    if jax.process_count() <= 1:
+        return vec
+    from jax.experimental import multihost_utils
+    out = np.empty_like(vec)
+    for s in range(0, len(vec), _COMBINE_SLICE_ELEMS):
+        e = min(s + _COMBINE_SLICE_ELEMS, len(vec))
+        out[s:e] = np.asarray(
+            multihost_utils.process_allgather(
+                np.ascontiguousarray(vec[s:e]))).sum(axis=0)
+    return out
 
 
 # Keep the whole dataset device-resident across scoring seeds when it fits
@@ -178,6 +251,20 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     # every uploaded batch live — an OOM for >HBM datasets, the exact case
     # streaming exists for). Resident mode holds the dataset anyway: one flush.
     window = len(resident) if resident is not None else 8
+    # Multi-process fetch engine: STREAM (default) fetches only this rank's
+    # shards per flush — a local DMA overlapped with the next window's
+    # dispatch — and joins ranks with ONE sliced sum per seed, so the [N]
+    # score vector never round-trips whole through every process per flush.
+    # DDT_SCORE_FETCH=allgather keeps the legacy per-flush collective
+    # (pinned identical by the 2-process drill). Gated on a sharder: the
+    # per-rank ownership invariant (replica_id 0 covers each row once
+    # globally) holds only for globally-SHARDED score arrays — a
+    # sharder-less multi-process call scores per-process LOCAL arrays where
+    # every rank owns everything, and streaming them would world-x
+    # double-count at the seed join (those arrays are fully addressable, so
+    # the legacy branch below is already collective-free for them).
+    stream = (jax.process_count() > 1 and sharder is not None
+              and resolve_fetch_mode() == "stream")
     for k, variables in enumerate(variables_seeds):
         # Per-seed accumulator (not straight into ``total``): the completed
         # seed's vector is what on_seed_done persists for stage resume.
@@ -185,9 +272,16 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
         pending: list[tuple[np.ndarray, np.ndarray, jax.Array]] = []
 
         def flush():
-            for (idx, mask, _), scores in zip(
-                    pending, _to_host([p[2] for p in pending])):
-                seed_scores[pos_of(idx[mask])] += scores[mask]
+            with obs_registry.timed("score_fetch_s"):
+                if stream:
+                    for idx, mask, arr in pending:
+                        for rows, data in _local_shard_rows(arr):
+                            m = mask[rows]
+                            seed_scores[pos_of(idx[rows][m])] += data[m]
+                else:
+                    for (idx, mask, _), scores in zip(
+                            pending, _to_host([p[2] for p in pending])):
+                        seed_scores[pos_of(idx[mask])] += scores[mask]
             pending.clear()
 
         for idx, mask, batch in (resident if resident is not None
@@ -196,6 +290,12 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
             if len(pending) >= window:
                 flush()
         flush()
+        if stream:
+            # The seed-boundary rank join: every process ends the pass with
+            # the full [N] float64 vector — the contract stage-resume
+            # partials and the scoreboard rely on — via one sliced sum.
+            with obs_registry.timed("score_fetch_s"):
+                seed_scores = _sum_across_processes(seed_scores)
         total += seed_scores
         # Observatory note BEFORE the caller hook: on_seed_done may raise
         # (seed-boundary Preempted) and the completed pass's stats belong in
@@ -290,9 +390,10 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
         # ONE fetch per seed — the score blocks' round trip is the epoch's
         # entire device→host traffic (float64 exactly represents every
         # float32, so the resumed-partial mean stays bit-identical).
-        seed_scores = np.concatenate(
-            [np.asarray(o, np.float64) for o in jax.device_get(outs)],
-            axis=0).reshape(-1)[:resident.n]
+        with obs_registry.timed("score_fetch_s"):
+            seed_scores = np.concatenate(
+                [np.asarray(o, np.float64) for o in jax.device_get(outs)],
+                axis=0).reshape(-1)[:resident.n]
         total += seed_scores
         obs_scoreboard.note_seed_scores(
             method, seed_ids[k] if seed_ids is not None else k, seed_scores)

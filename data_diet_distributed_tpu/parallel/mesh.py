@@ -19,6 +19,8 @@ unchanged.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -99,6 +101,18 @@ def replicate(tree, mesh: Mesh):
     return _device_put(tree, replicated(mesh))
 
 
+def _param_spec_for(path, tp: bool) -> P:
+    """The per-leaf parameter spec rule (shared by ``param_specs`` and the
+    sharded-update specs): replicated, except the TP classifier head."""
+    names = [getattr(p, "key", str(p)) for p in path]
+    if tp and "classifier" in names:
+        if names[-1] == "kernel":
+            return P(None, MODEL_AXIS)
+        if names[-1] == "bias":
+            return P(MODEL_AXIS)
+    return P()
+
+
 def param_specs(params, mesh: Mesh):
     """PartitionSpecs for model parameters.
 
@@ -108,17 +122,8 @@ def param_specs(params, mesh: Mesh):
     ``num_classes / model`` columns and XLA all-gathers logits only where needed.
     """
     tp = mesh.shape[MODEL_AXIS] > 1
-
-    def spec_for(path, leaf):
-        names = [getattr(p, "key", str(p)) for p in path]
-        if tp and "classifier" in names:
-            if names[-1] == "kernel":
-                return P(None, MODEL_AXIS)
-            if names[-1] == "bias":
-                return P(MODEL_AXIS)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _param_spec_for(path, tp), params)
 
 
 def _path_names(path) -> tuple:
@@ -136,7 +141,94 @@ def _zero1_spec(spec: P, shape, data_size: int) -> P:
     return spec
 
 
-def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
+@dataclasses.dataclass(frozen=True)
+class UpdateSharding:
+    """Cross-replica SHARDED weight update (arXiv 2004.13336 — the recipe
+    behind ZeRO-on-TPU), as a hashable handle the jitted step factories key
+    their cache on.
+
+    The replicated baseline computes every gradient as an all-reduce and runs
+    the full optimizer update on every replica. Armed with this handle, the
+    train step instead:
+
+    * constrains each gradient leaf to a ``data``-axis sharded layout
+      (``_zero1_spec`` — the same rule ZeRO-1 slot sharding uses), so GSPMD
+      lowers the gradient reduction to a reduce-SCATTER;
+    * runs the optimizer update on sharded grads + sharded slots — each
+      replica updates only its ``1/data_axis`` parameter shard;
+    * keeps the updated params SHARDED between steps (``place_state`` places
+      them that way too): the weight all-gather happens at USE, inside the
+      next forward, where the latency-hiding scheduler can overlap it
+      layer-by-layer against compute — and where it is bit-exact (pure data
+      movement). Re-gathering at the update's tail instead measurably
+      changes the backward's reduction order on the CPU lane (~3e-8 drift);
+      this formulation is tree-equal BIT-identical to the replicated update
+      (pinned by tests/test_sharded_update.py and the 2-process drill).
+
+    Leaves too small/odd-shaped to shard (``_zero1_spec`` returns the spec
+    unchanged) keep the replicated update for that leaf — partial sharding is
+    the general case, not an error.
+    """
+
+    mesh: Mesh
+
+    @property
+    def data_size(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    def spec_for(self, path, leaf) -> P:
+        tp = self.mesh.shape[MODEL_AXIS] > 1
+        return _zero1_spec(_param_spec_for(path, tp),
+                           getattr(leaf, "shape", ()), self.data_size)
+
+    def shard(self, tree):
+        """Constrain a param-shaped tree (grads, updates, params) to the
+        sharded-update layout — the reduce-scatter point when applied to
+        gradients inside jit."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, self.spec_for(path, x))), tree)
+
+    def place(self, tree):
+        """Device-place a param-shaped tree in the sharded-update layout
+        (host-side twin of ``shard``; used by ``place_state``)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: _device_put(
+                x, NamedSharding(self.mesh, self.spec_for(path, x))), tree)
+
+    def sharded_fraction(self, params) -> float:
+        """Fraction of parameter BYTES the update actually shards (leaves
+        ``_zero1_spec`` could place on the data axis) — the honest number the
+        comm gauges report instead of assuming every byte reduce-scatters."""
+        total = sharded = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            n = int(getattr(leaf, "nbytes",
+                            getattr(leaf, "size", 0) * 4))
+            total += n
+            if DATA_AXIS in tuple(self.spec_for(path, leaf)):
+                sharded += n
+        return sharded / total if total else 0.0
+
+
+def resolve_update_sharding(cfg_mesh, mesh: Mesh) -> UpdateSharding | None:
+    """The sharded-weight-update selection policy (None = replicated update).
+
+    ``mesh.shard_weight_update``: True/False explicit; None = auto, armed by
+    ``DDT_SHARDED_UPDATE=1`` — the same env-gate discipline as the GraNd
+    megakernel (default OFF pending the on-chip bisection; the CPU-mesh
+    bit-identity is pinned either way). A trivial data axis has nothing to
+    shard over."""
+    import os
+    armed = cfg_mesh.shard_weight_update
+    if armed is None:
+        armed = os.environ.get("DDT_SHARDED_UPDATE", "") not in ("", "0")
+    if not armed or mesh.shape[DATA_AXIS] <= 1:
+        return None
+    return UpdateSharding(mesh)
+
+
+def place_state(state, mesh: Mesh, shard_opt_state: bool = False,
+                update_sharding: "UpdateSharding | None" = None):
     """Device-place a TrainState: params AND their optimizer slots per
     ``param_specs``; everything else replicated. This is the production placement
     used by ``fit`` (the reference's equivalent surface is DDP model wrapping,
@@ -147,8 +239,15 @@ def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
     over ``data`` — each DP rank holds ``1/data_axis`` of the optimizer memory;
     params stay replicated and XLA gathers the slots where the update needs
     them (one all-gather per step, bought for optimizer memory).
+
+    ``update_sharding`` (the cross-replica sharded weight update): params
+    live data-axis SHARDED between steps, like the slots — the train step
+    reduce-scatters grads onto the same layout and the forward all-gathers
+    weights at use. Implies ``shard_opt_state``.
     """
     tp = mesh.shape[MODEL_AXIS] > 1
+    if update_sharding is not None:
+        shard_opt_state = True
     zero1 = shard_opt_state and mesh.shape[DATA_AXIS] > 1
     if not tp and not zero1:
         return replicate(state, mesh)
@@ -180,7 +279,8 @@ def place_state(state, mesh: Mesh, shard_opt_state: bool = False):
             spec = _zero1_spec(spec, leaf.shape, mesh.shape[DATA_AXIS])
         return spec
 
-    params = put(state.params, specs)
+    params = (update_sharding.place(state.params)
+              if update_sharding is not None else put(state.params, specs))
     opt_state = put(state.opt_state, jax.tree_util.tree_map_with_path(
         opt_spec, state.opt_state))
     rest = _device_put(

@@ -35,6 +35,7 @@ from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
                              maybe_resident, num_batches)
 from ..models import create_model_from_cfg
 from ..obs import MetricsLogger, flightrec, tracing
+from ..obs import comm as obs_comm
 from ..obs import fleet as obs_fleet
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
@@ -44,7 +45,8 @@ from ..obs import slo as obs_slo
 from ..obs import xla as obs_xla
 from ..obs.profiler import ProfileWindow
 from ..ops.scoring import score_dataset
-from ..parallel.mesh import is_primary, make_mesh, place_state, replicate
+from ..parallel.mesh import (is_primary, make_mesh, place_state, replicate,
+                             resolve_update_sharding)
 from ..pruning import (build_prune_manifest, select_indices,
                        verify_prune_manifest, write_prune_manifest)
 from ..resilience import inject
@@ -262,8 +264,13 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     # optimizer slots) tensor-parallel over 'model' when the mesh has one —
     # the train/eval jits then partition the head matmul and gather logits
     # via compiler-inserted collectives. mesh.shard_opt_state adds ZeRO-1
-    # optimizer-state sharding over the data axis.
-    state = place_state(state, mesh, shard_opt_state=cfg.mesh.shard_opt_state)
+    # optimizer-state sharding over the data axis; the cross-replica sharded
+    # weight update (mesh.shard_weight_update / DDT_SHARDED_UPDATE) places
+    # params in the SAME sharded layout — grads reduce-scatter, each replica
+    # updates its shard, the forward all-gathers weights at use.
+    update_sharding = resolve_update_sharding(cfg.mesh, mesh)
+    state = place_state(state, mesh, shard_opt_state=cfg.mesh.shard_opt_state,
+                        update_sharding=update_sharding)
 
     # Multi-host fault consensus (None single-process / disabled): agreed
     # preemption, agreed divergence, min-agreed restore, poison side-channel.
@@ -273,8 +280,16 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     start_epoch = 0
     try:
         if checkpoint_dir:
+            # checkpoint.local_tier arms the multi-tier write path (fast
+            # per-rank local saves, background promotion); readers discover
+            # tier steps with no config, so every other CheckpointManager
+            # construction site stays read-compatible.
             ckpt = CheckpointManager(checkpoint_dir,
-                                     max_to_keep=cfg.train.keep_checkpoints)
+                                     max_to_keep=cfg.train.keep_checkpoints,
+                                     tier=(cfg.checkpoint
+                                           if cfg.checkpoint.local_tier
+                                           else None),
+                                     logger=logger)
             if cfg.train.resume and (resume_step is not None
                                      or ckpt.latest_step() is not None):
                 if consensus is not None:
@@ -342,7 +357,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     try:
         augment = ((cfg.data.crop_pad, cfg.data.flip, cfg.train.seed)
                    if cfg.data.augment else None)
-        train_step = make_train_step(model, augment)
+        train_step = make_train_step(model, augment, update_sharding)
         eval_step = make_eval_step(model) if test_ds is not None else None
 
         # Device-resident epoch data: upload the (pruned) train set — and the
@@ -410,7 +425,15 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         test_resident, steps_per_epoch, epoch_hook,
                         watchdog=watchdog, preempt=preempt, sentinel=sentinel,
                         consensus=consensus, chunk_steps=chunk_steps,
-                        augment=augment, profile=profile)
+                        augment=augment, profile=profile,
+                        update_sharding=update_sharding)
+        # Comm telemetry, once per fit AFTER the epochs (the XLA harvest has
+        # run by then, so the overlap ratio can read the program's flops):
+        # analytic per-step collective bytes + overlap verdict + fetch wall.
+        obs_comm.note_update_comm(
+            result.state.params, mesh, update_sharding, logger=logger,
+            program="train_chunk" if chunk_steps > 1 else "train_step",
+            tag=tag)
     finally:
         if profile is not None:
             profile.close()   # a mid-capture exception must stop the profiler
@@ -448,7 +471,16 @@ def _preempt_exit(preempt, ckpt, state, logger, tag, epoch, steps_per_epoch,
             if saved_steps is not None:
                 saved_steps.append(step)
             durable = step
-        ckpt.all_steps()   # durability barrier: the async save must land
+        # Durability barrier: async Orbax saves land / tier promotions
+        # drain. The claim below must then match the LISTING — a failed or
+        # timed-out tier promotion leaves the step off it, and reporting it
+        # durable anyway would make the orchestrator resume into a loss
+        # (the Orbax path raises at the barrier; the tier path reports).
+        landed = ckpt.all_steps()
+        if durable is not None and durable not in landed:
+            logger.fault("checkpoint_not_durable", tag=tag, step=durable,
+                         durable_steps=landed[-3:])
+            durable = None
     logger.log("preempted", tag=tag, signal=preempt.signame, step=step,
                epoch=epoch, durable_step=durable)
     # The ring now ends with the signal receipt + this preempted event —
@@ -501,8 +533,9 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 saved_steps=None, train_resident=None, test_resident=None,
                 steps_per_epoch=None, epoch_hook=None, watchdog=None,
                 preempt=None, sentinel=None, consensus=None, chunk_steps=1,
-                augment=None, profile=None):
-    chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding)
+                augment=None, profile=None, update_sharding=None):
+    chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding,
+                                 update_sharding)
                 if chunk_steps > 1 else None)
     # Live-introspection wiring (no-op unless a status server is installed):
     # /healthz reads this fit's watchdog margin + consensus poison state
@@ -1399,7 +1432,14 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
         summary["scoring_shared"] = True
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
     if stages is not None:
-        stages.complete(stage, summary=summary)
+        # Which TIER each of this stage's checkpoint steps lives in
+        # ("durable" = promoted local-tier, "orbax" = classic composite,
+        # "local" = saved but never promoted) — recorded in the stage
+        # manifest so a resume knows what it is trusting.
+        from ..checkpoint import tier_map
+        stages.complete(stage, summary=summary,
+                        ckpt_tiers=tier_map(ckpt_dir,
+                                            cfg.checkpoint.local_dir))
     return summary
 
 
@@ -1515,5 +1555,8 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         "total_wall_s": time.perf_counter() - t0,
     }
     logger.log("summary", **{k: v for k, v in summary.items() if v is not None})
-    stages.complete(stage, summary=summary)
+    from ..checkpoint import tier_map
+    stages.complete(stage, summary=summary,
+                    ckpt_tiers=tier_map(cfg.train.checkpoint_dir,
+                                        cfg.checkpoint.local_dir))
     return summary

@@ -327,3 +327,29 @@ def test_bench_serve_port_and_slo_verdict(tmp_path):
     assert recs[-1]["kind"] == "perf_history"
     assert recs[-1]["slo"]["verdict"] == "ok"
     assert recs[-1]["serve"]["requests"] >= 2
+
+
+def test_bench_train_embeds_comm_block():
+    """--task train records mesh geometry + analytic per-step collective
+    bytes + the overlap verdict in a "comm" block, so the perf-sentry
+    ledger can baseline comm regressions next to throughput (ISSUE 10)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               DDT_SHARDED_UPDATE="1")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--no-ledger", "--no-probe",
+         "--task", "train", "--size", "256", "--batch", "64",
+         "--arch", "tiny_cnn", "--repeats", "1"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "train_examples_per_sec_per_chip"
+    comm = line["comm"]
+    assert comm["mesh"] == {"data": 8, "model": 1, "processes": 1}
+    assert comm["sharded_update"] is True
+    assert comm["reduce_scatter_bytes"] > 0
+    assert comm["all_gather_bytes"] > 0
+    assert comm["bytes_per_step"] > 0
+    # CPU lane: no link-bandwidth table entry — the ratio is null with its
+    # provenance named, never invented.
+    assert comm["overlap_ratio"] is None
+    assert comm["overlap_ratio_source"].startswith("no-link-bandwidth")

@@ -1,0 +1,210 @@
+"""Async multi-tier checkpointing (checkpoint.py LocalTier) — single-process
+pins. The 2-process consensus drill lives in test_pod_scale.py.
+
+Claims: the fast local-tier save promotes in the background to a
+digest-verified durable tier that round-trips BIT-exactly through the
+standard CheckpointManager read API (config-free readers); corruption of a
+promoted shard is caught by the digest and falls back; a SIGTERM landing
+while a promotion is in flight drains to a durable, restorable step; and the
+tier transitions are observable ({"kind": "ckpt_tier"} records, stage-
+manifest tier map).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.checkpoint import (CheckpointManager,
+                                                  local_tier_dir, tier_map,
+                                                  tier_steps, tiered_dir)
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.data.datasets import load_dataset
+from data_diet_distributed_tpu.data.pipeline import BatchSharder
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.parallel.mesh import make_mesh, place_state
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.resilience.integrity import CheckpointCorrupt
+from data_diet_distributed_tpu.resilience.preemption import Preempted
+from data_diet_distributed_tpu.train.loop import fit
+from data_diet_distributed_tpu.train.state import create_train_state
+
+
+def _tiny_cfg(tmp_path, **over):
+    overrides = [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=2", "train.half_precision=false",
+        "train.checkpoint_every=1", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        "checkpoint.local_tier=true",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "score.pretrain_epochs=0",
+    ] + [f"{k}={v}" for k, v in over.items()]
+    return load_config(None, overrides)
+
+
+def _fit(cfg, mesh, logger=None):
+    sharder = BatchSharder(mesh)
+    train_ds, _ = load_dataset("synthetic", synthetic_size=256, seed=0)
+    return fit(cfg, train_ds, None, mesh=mesh, sharder=sharder,
+               logger=logger, checkpoint_dir=cfg.train.checkpoint_dir)
+
+
+def _template(cfg, mesh):
+    return place_state(
+        create_train_state(cfg, jax.random.key(0), steps_per_epoch=4), mesh)
+
+
+def test_tier_save_promotes_and_roundtrips_bit_exact(tmp_path, mesh8):
+    cfg = _tiny_cfg(tmp_path)
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    res = _fit(cfg, mesh8, logger)
+    logger.close()
+    ckpt_dir = cfg.train.checkpoint_dir
+    assert tier_steps(ckpt_dir) == [4, 8]
+    assert tier_map(ckpt_dir) == {"4": "durable", "8": "durable"}
+    # Readers need NO tier config: a plain manager serves tier steps.
+    mngr = CheckpointManager(ckpt_dir)
+    assert mngr.all_steps() == [4, 8]
+    restored, used = mngr.restore_verified(_template(cfg, mesh8))
+    assert used == 8 and int(restored.step) == 8
+    for a, b in zip(jax.tree.leaves(jax.device_get(restored.params)),
+                    jax.tree.leaves(jax.device_get(res.state.params))):
+        assert np.array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(restored.opt_state)),
+                    jax.tree.leaves(jax.device_get(res.state.opt_state))):
+        assert np.array_equal(a, b)
+    # Epoch metadata rides the tier manifest like the Orbax composite.
+    assert mngr.metrics(8)["epoch"] == 1
+    mngr.close()
+    # The tier records validate against the stream schema.
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from validate_metrics import validate_file
+    problems = validate_file(cfg.obs.metrics_path)
+    assert not problems, problems
+    kinds = [json.loads(ln).get("kind")
+             for ln in open(cfg.obs.metrics_path)]
+    assert kinds.count("ckpt_tier") >= 4   # 2 local + 2 durable
+    assert "comm_stats" in kinds
+
+
+def test_tier_roundtrips_sharded_update_state(tmp_path, mesh8):
+    """Params living SHARDED between steps (the sharded weight update) save
+    as true per-owner shards and restore into the sharded template
+    bit-exactly."""
+    cfg = _tiny_cfg(tmp_path, **{"mesh.shard_weight_update": "true",
+                                 "train.num_epochs": 1})
+    res = _fit(cfg, mesh8)
+    mngr = CheckpointManager(cfg.train.checkpoint_dir)
+    from data_diet_distributed_tpu.parallel.mesh import UpdateSharding
+    template = place_state(
+        create_train_state(cfg, jax.random.key(0), steps_per_epoch=4),
+        mesh8, update_sharding=UpdateSharding(mesh8))
+    restored = mngr.restore_checked(template, 4)
+    for a, b in zip(jax.tree.leaves(jax.device_get(restored.params)),
+                    jax.tree.leaves(jax.device_get(res.state.params))):
+        assert np.array_equal(a, b)
+    mngr.close()
+
+
+def test_corrupt_promoted_shard_is_caught_and_falls_back(tmp_path, mesh8):
+    cfg = _tiny_cfg(tmp_path)
+    _fit(cfg, mesh8)
+    ckpt_dir = cfg.train.checkpoint_dir
+    npz = os.path.join(tiered_dir(ckpt_dir), "step_8", "rank0.npz")
+    data = bytearray(open(npz, "rb").read())
+    # Flip bytes mid-payload (past the zip headers) — a digest must catch it.
+    data[len(data) // 2] ^= 0xFF
+    with open(npz, "wb") as fh:
+        fh.write(data)
+    mngr = CheckpointManager(ckpt_dir)
+    with pytest.raises((CheckpointCorrupt, Exception)):
+        mngr.restore_checked(_template(cfg, mesh8), 8)
+    # restore_verified falls back to the intact earlier tier step.
+    fallbacks = []
+    restored, used = mngr.restore_verified(
+        _template(cfg, mesh8),
+        on_fallback=lambda **kw: fallbacks.append(kw))
+    assert used == 4 and int(restored.step) == 4
+    assert fallbacks and fallbacks[0]["step"] == 8
+    mngr.close()
+
+
+def test_sigterm_mid_promotion_drains_to_durable_restorable(tmp_path, mesh8):
+    """Single-process twin of the 2-proc drill: SIGTERM at epoch-0 end while
+    the step-4 promotion is still asleep in its injected delay — the
+    preemption path's durability barrier drains it; the step is promoted,
+    digest-verified and restorable; resume continues from it."""
+    cfg = _tiny_cfg(tmp_path, **{"checkpoint.promote_delay_s": "1.0",
+                                 "train.num_epochs": 3})
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    try:
+        with pytest.raises(Preempted) as exc:
+            _fit(cfg, mesh8)
+    finally:
+        inject.deactivate()
+    assert exc.value.durable_step == 4
+    assert tier_steps(cfg.train.checkpoint_dir) == [4]
+    mngr = CheckpointManager(cfg.train.checkpoint_dir)
+    restored = mngr.restore_checked(_template(cfg, mesh8), 4)
+    assert int(restored.step) == 4
+    mngr.close()
+    cfg.train.resume = True
+    res = _fit(cfg, mesh8)
+    assert [r["epoch"] for r in res.history] == [1, 2]
+    assert int(res.state.step) == 12
+
+
+def test_preempt_with_unpromotable_save_reports_no_durable_step(
+        tmp_path, mesh8):
+    """The preemption path's durable_step claim must match the durable
+    LISTING: with promotion off, the final local save can never land, and
+    the Preempted report says durable_step=None (plus a fault record)
+    instead of pointing resume at a step that does not exist."""
+    cfg = _tiny_cfg(tmp_path, **{"checkpoint.promote": "false",
+                                 "train.num_epochs": 3})
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    try:
+        with pytest.raises(Preempted) as exc:
+            _fit(cfg, mesh8, logger)
+    finally:
+        inject.deactivate()
+        logger.close()
+    assert exc.value.durable_step is None
+    recs = [json.loads(ln) for ln in open(cfg.obs.metrics_path)]
+    faults = [r for r in recs if r.get("kind") == "fault"]
+    assert any(r.get("fault") == "checkpoint_not_durable" for r in faults)
+    preempted = [r for r in recs if r.get("kind") == "preempted"]
+    assert preempted and preempted[-1]["durable_step"] is None
+
+
+def test_local_tier_dir_namespaces_a_shared_configured_root():
+    """Two jobs sharing one configured local SSD root must get disjoint
+    scratch trees (a collision lets one run's promoter copy the OTHER run's
+    weights into its durable tier with passing digests)."""
+    a = local_tier_dir("/jobs/a/ckpt", "/local/ssd")
+    b = local_tier_dir("/jobs/b/ckpt", "/local/ssd")
+    assert a != b
+    assert a.startswith(os.path.abspath("/local/ssd") + os.sep)
+    assert b.startswith(os.path.abspath("/local/ssd") + os.sep)
+    assert local_tier_dir("/jobs/a/ckpt") == "/jobs/a/ckpt_local"
+    assert local_tier_dir("/jobs/a/ckpt", "/local/ssd") == a   # stable
+
+
+def test_unpromoted_local_save_never_counts_as_durable(tmp_path, mesh8):
+    cfg = _tiny_cfg(tmp_path, **{"checkpoint.promote": "false",
+                                 "train.num_epochs": 1})
+    _fit(cfg, mesh8)
+    ckpt_dir = cfg.train.checkpoint_dir
+    assert tier_steps(ckpt_dir) == []
+    assert tier_map(ckpt_dir) == {"4": "local"}
+    mngr = CheckpointManager(ckpt_dir)
+    assert mngr.all_steps() == []
+    with pytest.raises(FileNotFoundError):
+        mngr.restore(_template(cfg, mesh8))
+    mngr.close()

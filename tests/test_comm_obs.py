@@ -1,0 +1,105 @@
+"""Comm observability (obs/comm.py): analytic collective-byte estimates,
+the overlap-ratio estimate with provenance, the fetch-wall summary, and the
+comm_stats record's schema contract.
+"""
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.obs import comm as obs_comm
+from data_diet_distributed_tpu.obs import registry as obs_registry
+from data_diet_distributed_tpu.obs.registry import MetricsRegistry
+from data_diet_distributed_tpu.parallel.mesh import UpdateSharding
+
+PARAMS = {"conv": {"kernel": np.zeros((3, 3, 3, 16), np.float32),
+                   "bias": np.zeros((16,), np.float32)},
+          "head": {"bias": np.zeros((10,), np.float32)}}
+PARAM_BYTES = (3 * 3 * 3 * 16 + 16 + 10) * 4
+SHARDABLE = (3 * 3 * 3 * 16 + 16) * 4
+
+
+def test_estimate_replicated_update_all_reduces_everything(mesh8):
+    est = obs_comm.estimate_update_comm(PARAMS, mesh8, None)
+    ring = 7 / 8
+    assert est["data_axis"] == 8 and est["param_bytes"] == PARAM_BYTES
+    assert est["sharded_update"] is False and est["sharded_frac"] == 0.0
+    assert est["reduce_scatter_bytes"] == 0 and est["all_gather_bytes"] == 0
+    assert est["all_reduce_bytes"] == int(PARAM_BYTES * 2 * ring)
+    assert est["bytes_per_step"] == est["all_reduce_bytes"]
+
+
+def test_estimate_sharded_update_splits_the_traffic(mesh8):
+    est = obs_comm.estimate_update_comm(PARAMS, mesh8, UpdateSharding(mesh8))
+    ring = 7 / 8
+    assert est["sharded_update"] is True
+    assert est["sharded_frac"] == pytest.approx(SHARDABLE / PARAM_BYTES,
+                                                abs=1e-4)
+    assert est["reduce_scatter_bytes"] == int(SHARDABLE * ring)
+    assert est["all_gather_bytes"] == int(SHARDABLE * ring)
+    # The unshardable remainder still all-reduces.
+    assert est["all_reduce_bytes"] == int((PARAM_BYTES - SHARDABLE) * 2 * ring)
+    # Same ring total as the all-reduce baseline for the shardable bytes —
+    # the win is overlapability, not volume.
+    assert (est["reduce_scatter_bytes"] + est["all_gather_bytes"]
+            == 2 * int(SHARDABLE * ring))
+
+
+def test_overlap_ratio_provenance(monkeypatch):
+    # No comm -> fully hidden by convention.
+    assert obs_comm.overlap_ratio(0, 1e9) == (1.0, "no-comm")
+    # No cost analysis -> null, named.
+    ratio, src = obs_comm.overlap_ratio(1000, None)
+    assert ratio is None and src == "no-cost-analysis"
+    # CPU lane: no link-bandwidth table entry -> null, named.
+    monkeypatch.delenv("DDT_INTERCONNECT_BYTES_PER_S", raising=False)
+    ratio, src = obs_comm.overlap_ratio(1000, 1e9)
+    assert ratio is None and src.startswith("no-link-bandwidth")
+    # Env-pinned bandwidth + peak: the estimate computes and clamps to 1.
+    monkeypatch.setenv("DDT_INTERCONNECT_BYTES_PER_S", "1e9")
+    monkeypatch.setenv("DDT_PEAK_FLOPS_PER_DEVICE", "1e12")
+    # compute_s = 1e9/1e12 = 1e-3; comm_s = 1e6/1e9 = 1e-3 -> ratio 1.0
+    ratio, src = obs_comm.overlap_ratio(int(1e6), 1e9)
+    assert ratio == pytest.approx(1.0) and src == "estimated:env"
+    # comm 10x the compute -> only a tenth hideable.
+    ratio, _ = obs_comm.overlap_ratio(int(1e7), 1e9)
+    assert ratio == pytest.approx(0.1)
+
+
+def test_comm_block_and_record_schema(mesh8, tmp_path, monkeypatch):
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    monkeypatch.delenv("DDT_INTERCONNECT_BYTES_PER_S", raising=False)
+    reg = obs_registry.install(MetricsRegistry())
+    try:
+        with obs_registry.timed("score_fetch_s"):
+            pass
+        path = str(tmp_path / "m.jsonl")
+        logger = MetricsLogger(path, echo=False)
+        block = obs_comm.note_update_comm(PARAMS, mesh8, None, logger=logger,
+                                          tag="t")
+        logger.close()
+        assert block["fetch_wall_s"]["count"] == 1
+        snap = reg.snapshot()
+        assert snap["gauges"]["comm_bytes_per_step"] == block["bytes_per_step"]
+        import sys, os
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        from validate_metrics import validate_file
+        assert validate_file(path) == []
+        import json
+        rec = [json.loads(ln) for ln in open(path)][0]
+        assert rec["kind"] == "comm_stats"
+        assert rec["mesh"] == {"data": 8, "model": 1}
+        assert rec["overlap_ratio"] is None   # CPU lane: null, never invented
+    finally:
+        obs_registry.uninstall()
+
+
+def test_fetch_wall_absent_without_fetches(mesh8):
+    reg = obs_registry.install(MetricsRegistry())
+    try:
+        block = obs_comm.comm_block(PARAMS, mesh8, None)
+        assert "fetch_wall_s" not in block
+        # Peeking must not have minted an empty histogram.
+        assert reg.peek_histogram("score_fetch_s") is None
+    finally:
+        obs_registry.uninstall()

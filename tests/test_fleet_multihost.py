@@ -39,7 +39,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(out_dir, _retry=True) -> list[dict]:
+def _launch(out_dir, _retry=2) -> list[dict]:
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
@@ -64,8 +64,11 @@ def _launch(out_dir, _retry=True) -> list[dict]:
             p.returncode != 0 and (p.returncode == -6 or any(
                 sig in out for sig in _INFRA_CRASH_SIGNATURES))
             for p, out in zip(procs, outs)):
-        print("--- environmental worker crash; one retry")
-        return _launch(out_dir, _retry=False)
+        # Budget 2 (was 1): see test_multihost.py — the suite now runs more
+        # 2-proc launches and the gloo abort has been seen twice in a row.
+        print(f"--- environmental worker crash; {_retry} retr"
+              f"{'ies' if _retry > 1 else 'y'} left")
+        return _launch(out_dir, _retry=_retry - 1)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     results = []

@@ -36,7 +36,7 @@ _INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
                            "Shutdown barrier has failed")
 
 
-def _launch_pair(out_dir, model_axis: int, _retry=True) -> list[dict]:
+def _launch_pair(out_dir, model_axis: int, _retry=2) -> list[dict]:
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
@@ -60,8 +60,12 @@ def _launch_pair(out_dir, model_axis: int, _retry=True) -> list[dict]:
             p.returncode != 0 and (p.returncode == -6 or any(
                 sig in out for sig in _INFRA_CRASH_SIGNATURES))
             for p, out in zip(procs, outs)):
-        print("--- environmental worker crash; one retry")
-        return _launch_pair(out_dir, model_axis, _retry=False)
+        # Budget 2 (was 1): the gloo torn-frame abort has been observed
+        # twice in a row now that the suite runs more 2-proc launches;
+        # assertion-class failures never match these signatures.
+        print(f"--- environmental worker crash; {_retry} retr"
+              f"{'ies' if _retry > 1 else 'y'} left")
+        return _launch_pair(out_dir, model_axis, _retry=_retry - 1)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     results = []

@@ -244,3 +244,40 @@ def test_bench_appends_ledger_record(tmp_path):
     sys.path.insert(0, str(REPO / "tools"))
     import validate_metrics as vm
     assert vm.validate_file(ledger) == []
+
+
+# ------------------------------------------------------------ comm metric
+
+
+def test_injected_comm_regression_trips_exit_one(tmp_path):
+    """ISSUE 10 acceptance: a comm-bytes blow-up past the threshold fails
+    the sentry even when the headline throughput still looks fine — the
+    analytic bytes are structural, not noisy."""
+    def rec(v, bytes_per_step):
+        return _rec(v, comm={"bytes_per_step": bytes_per_step,
+                             "overlap_ratio": 0.9})
+    healthy = [rec(100.0 + i, 1_000_000) for i in range(4)]
+    path = _ledger(tmp_path, healthy + [rec(104.0, 1_250_000)])
+    assert ps.main([path]) == ps.EXIT_REGRESSION
+    rep = ps.check_ledger(ps.load_ledger(path))
+    g = rep["groups"][0]
+    assert g["status"] == ps.REGRESSION and g["comm_regression"] is True
+    assert g["comm_baseline_median"] == 1_000_000
+    # Within the threshold: ok (and the comm fields still reported).
+    path = _ledger(tmp_path, healthy + [rec(104.0, 1_050_000)], "ok.jsonl")
+    assert ps.main([path]) == ps.EXIT_OK
+    g = ps.check_ledger(ps.load_ledger(path))["groups"][0]
+    assert g["status"] in (ps.OK, ps.IMPROVEMENT)
+    assert g["comm_delta_frac"] == pytest.approx(-0.05)
+
+
+def test_comm_metric_ignores_records_without_comm(tmp_path):
+    """Mixed trails (pre-comm records, zero-comm single-device geometries)
+    neither crash the sentry nor invent a baseline."""
+    recs = [_rec(100.0), _rec(101.0),
+            _rec(102.0, comm={"bytes_per_step": 0}),
+            _rec(103.0, comm={"bytes_per_step": 500_000})]
+    path = _ledger(tmp_path, recs)
+    assert ps.main([path]) == ps.EXIT_OK
+    g = ps.check_ledger(ps.load_ledger(path))["groups"][0]
+    assert "comm_delta_frac" not in g   # no clean comm baseline exists yet

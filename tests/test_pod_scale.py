@@ -1,0 +1,142 @@
+"""Pod-scale comm/checkpoint drills on the REAL 2-process runtime (ISSUE 10).
+
+One tier-1 launch (``pod_scale``) pins the two numerics claims:
+
+* the cross-replica sharded weight update (grads reduce-scattered onto the
+  data axis, per-replica shard update, weights all-gathered at use) is
+  tree-equal BIT-identical to the replicated update — params, optimizer
+  state, and the numeric history;
+* the streaming per-shard score fetch (rank-local shard DMA + one
+  cross-process sum per seed) joins to EXACTLY the ``[N]`` vector the legacy
+  per-flush ``process_allgather`` produces, across score methods.
+
+A second launch pins the async-tier fault drill: a SIGTERM landing while a
+local-tier save's promotion is still in flight must drain to a
+digest-verified durable checkpoint at the consensus-agreed step on BOTH
+ranks (exit 75), and re-invocation must resume from it through the tier
+restore path.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+EXIT_PREEMPTED = 75
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# Environmental crash signatures — retried ONCE; same rationale as
+# test_multihost.py / test_consensus_multihost.py.
+_INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "Shutdown barrier has failed")
+
+
+def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=2):
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", coordinator, str(out_dir),
+             "1", scenario],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    wall = time.monotonic() - t0
+    rcs = [p.returncode for p in procs]
+    if _retry and any(
+            rc == -6 or any(sig in out for sig in _INFRA_CRASH_SIGNATURES)
+            for rc, out in zip(rcs, outs)):
+        # Budget 2 (vs the other harnesses' 1): the oversubscribed-box gloo
+        # torn-frame abort has been observed twice in a row under full-suite
+        # load; assertion-class failures never match these signatures.
+        print(f"--- {scenario}: environmental crash (rcs={rcs}); "
+              f"{_retry} retr{'ies' if _retry > 1 else 'y'} left")
+        for pid in range(2):
+            try:
+                os.remove(os.path.join(str(out_dir), f"result_{pid}.json"))
+            except FileNotFoundError:
+                pass
+        return _launch(out_dir, scenario, timeout_s, _retry=_retry - 1)
+    results = []
+    for pid in range(2):
+        path = os.path.join(str(out_dir), f"result_{pid}.json")
+        try:
+            with open(path) as fh:
+                results.append(json.load(fh))
+        except FileNotFoundError:
+            results.append(None)
+    for p, out, r in zip(procs, outs, results):
+        if r is None:
+            print(f"--- worker without result json (rc={p.returncode}):\n"
+                  f"{out[-2000:]}")
+    return rcs, results, wall
+
+
+def test_sharded_update_and_streaming_fetch_2proc(tmp_path):
+    """ISSUE acceptance: sharded update bit-identical to replicated AND the
+    streaming score fetch identical to the allgather fetch, on the real
+    2-process mesh."""
+    rcs, results, _ = _launch(tmp_path, "pod_scale", timeout_s=540)
+    assert rcs == [0, 0], (rcs, results)
+    for r in results:
+        assert r is not None and r["outcome"] == "completed", results
+        assert r["sharded_params_equal"] is True, r
+        assert r["sharded_opt_equal"] is True, r
+        assert r["history_equal"] is True, r
+        for method, equal in r["fetch_equal"].items():
+            assert equal is True, (method, r)
+    # Both ranks computed the SAME full vectors (the streaming fetch's
+    # cross-process sum really did deliver [N] everywhere).
+    assert results[0]["scores_sums"] == pytest.approx(
+        results[1]["scores_sums"], rel=1e-6)
+
+
+def test_sigterm_during_tier_save_drains_to_verified_checkpoint(tmp_path):
+    """ISSUE acceptance (ii): rank-1 SIGTERM while the epoch-0 local-tier
+    promotion is still in flight (injected 1.5 s delay) -> both ranks drain,
+    agree, and exit 75 with the SAME digest-verified durable step; resume
+    restores it through the tier path."""
+    rcs, results, wall = _launch(tmp_path, "sigterm_tier_save", timeout_s=420)
+    assert wall < 420
+    assert rcs == [EXIT_PREEMPTED, EXIT_PREEMPTED], (rcs, results)
+    for r in results:
+        assert r is not None and r["outcome"] == "preempted", results
+    assert results[0]["durable_step"] == results[1]["durable_step"] == 4
+    # The durable tier really holds step 4, promoted by BOTH ranks.
+    tier_dir = os.path.join(str(tmp_path), "ckpt_tiered", "step_4")
+    for rank in (0, 1):
+        assert os.path.exists(
+            os.path.join(tier_dir, f"promoted.rank{rank}.json"))
+
+    rcs, results, _ = _launch(tmp_path, "resume_after_tier_preempt",
+                              timeout_s=420)
+    assert rcs == [0, 0], (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed"
+        # Restored the agreed tier step 4 (end of epoch 0): epochs 1..2
+        # remain of 3 — the tier restore passed manifest verification on
+        # both ranks (restore_checked raises otherwise).
+        assert r["epochs_run"] == [1, 2]
+        assert r["final_step"] == 12

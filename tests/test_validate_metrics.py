@@ -153,6 +153,26 @@ def test_score_observatory_kinds_validate(vm):
     assert "kind 'prune_decision' missing required field 'kept_digest'" in text
 
 
+def test_pod_scale_kinds_validate(vm):
+    """comm_stats / ckpt_tier (ISSUE 10): required fields enforced,
+    null-tolerant values accepted (a CPU lane nulls the overlap ratio)."""
+    ok = [
+        json.dumps({"ts": 1.0, "kind": "comm_stats",
+                    "mesh": {"data": 8, "model": 1}, "bytes_per_step": 12345,
+                    "overlap_ratio": None, "sharded_update": True}),
+        json.dumps({"ts": 2.0, "kind": "ckpt_tier", "step": 4,
+                    "tier": "local", "rank": 0}),
+        json.dumps({"ts": 3.0, "kind": "ckpt_tier", "step": 4,
+                    "tier": "durable", "wall_s": 0.01}),
+    ]
+    assert vm.validate_lines(ok) == []
+    bad = [json.dumps({"ts": 1.0, "kind": "comm_stats", "mesh": {}}),
+           json.dumps({"ts": 2.0, "kind": "ckpt_tier", "step": 4})]
+    text = "\n".join(vm.validate_lines(bad, where="s"))
+    assert "kind 'comm_stats' missing required field 'bytes_per_step'" in text
+    assert "kind 'ckpt_tier' missing required field 'tier'" in text
+
+
 def test_two_seed_run_stream_validates(vm, tmp_path, mesh8, tiny_ds):
     """The acceptance lane's real 2-seed CPU run, through the validator: the
     Observatory kinds the pipeline emits satisfy their own schema."""

@@ -70,6 +70,19 @@ def lower_is_better(rec: dict) -> bool:
     return str(rec.get("unit", "")).lower() in ("seconds", "s")
 
 
+def comm_bytes_per_step(rec: dict) -> float | None:
+    """The record's per-step collective-byte estimate (bench.py's ``comm``
+    block), or None when absent/zero — zero bytes means a geometry with no
+    data-axis collectives (single device), which has no comm to regress."""
+    comm = rec.get("comm")
+    if not isinstance(comm, dict):
+        return None
+    v = comm.get("bytes_per_step")
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+        return float(v)
+    return None
+
+
 def group_key(rec: dict) -> str:
     """Records are only comparable within the same (metric, backend,
     geometry) shape; geometry dicts canonicalize by sorted keys. Backfilled
@@ -136,6 +149,25 @@ def check_group(records: list[dict], *, threshold: float,
         out["status"] = IMPROVEMENT
     else:
         out["status"] = OK
+    # Comm sub-metric (records carrying bench's "comm" block): per-step
+    # collective bytes are lower-better and ANALYTIC, so a jump past the
+    # threshold is a structural regression (sharding/overlap config drift),
+    # not noise — it fails the group even when throughput still looks ok
+    # (a faster chip can mask a comm blow-up for a while).
+    nb = comm_bytes_per_step(newest)
+    if nb is not None:
+        comm_clean = [comm_bytes_per_step(r) for r in records[:-1]
+                      if classify_record(r) == CLEAN]
+        comm_clean = [v for v in comm_clean if v is not None][-window:]
+        if comm_clean:
+            cb = _median(comm_clean)
+            cdelta = -(nb - cb) / cb   # lower-better: positive = better
+            out["comm_bytes_per_step"] = nb
+            out["comm_baseline_median"] = cb
+            out["comm_delta_frac"] = round(cdelta, 4)
+            if cdelta < -threshold:
+                out["status"] = REGRESSION
+                out["comm_regression"] = True
     return out
 
 
@@ -220,6 +252,10 @@ def render(report: dict) -> str:
         if g.get("baseline_median") is not None:
             line += (f" vs median {round(g['baseline_median'], 2)}"
                      f" ({g['delta_frac'] * 100:+.1f}%)")
+        if g.get("comm_regression"):
+            line += (f" — COMM {g['comm_bytes_per_step']:.0f} B/step vs "
+                     f"median {g['comm_baseline_median']:.0f} "
+                     f"({g['comm_delta_frac'] * 100:+.1f}%)")
         if g.get("error"):
             line += f" — {g['error']}"
         lines.append(line)

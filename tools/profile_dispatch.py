@@ -19,12 +19,22 @@ Run: ``python tools/profile_dispatch.py [--task train|score] [--arch resnet18]
 [--batch 1024] [--method grand] [--k-long 16] [--frac 0.05]`` (add
 ``JAX_PLATFORMS=cpu`` for the CPU lane — the numbers then describe CPU
 dispatch, useful only for relative sanity).
+
+``--nproc 2`` reruns the train-task quotient through a REAL N-process
+``jax.distributed`` runtime (the 2-process test harness's shape: each worker
+owns 4 virtual CPU devices on the CPU lane): the chunk program's gradient
+reduction then spans processes, so ``t(K)`` — and the recommended chunk size
+— includes the collective cost a single-process measurement cannot see.
+``--sharded-update`` arms the cross-replica sharded weight update inside the
+measured program (reduce-scatter + at-use all-gather instead of all-reduce).
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import socket
+import subprocess
 import sys
 import time
 
@@ -71,6 +81,32 @@ def _report(args, label: str, unit_name: str, t1: float, tl: float,
           f"(dispatch tax <= {args.frac:.0%} of compute; clamp {clamp})")
 
 
+class _ReplicatedResident:
+    """Resident-shaped operand bundle for MULTI-process profiling: the same
+    replicated images/labels/indices + data-sharded gather layout the
+    single-process ``ResidentBatches`` holds, placed via the multi-process-
+    safe ``_device_put`` (``ResidentBatches`` itself refuses process_count >
+    1 because production multi-host runs stream — the profiler only needs
+    the chunk program's operands, and every process feeds identical host
+    arrays here)."""
+
+    def __init__(self, ds, mesh, image_dtype):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from data_diet_distributed_tpu.parallel.mesh import _device_put
+        dense = ds.dense()
+        rep = NamedSharding(mesh, P())
+        self.n = len(ds)
+        self.out_sharding = NamedSharding(mesh, P("data"))
+        self.images = _device_put(
+            np.asarray(dense.images, jnp.dtype(image_dtype)), rep)
+        self.labels = _device_put(
+            np.ascontiguousarray(dense.labels, np.int32), rep)
+        self.indices = _device_put(
+            np.ascontiguousarray(dense.indices, np.int32), rep)
+
+
 def profile_train(args) -> None:
     size = args.size or args.batch
     cfg = load_config(None, [
@@ -83,12 +119,21 @@ def profile_train(args) -> None:
     batch = sharder.global_batch_size_for(args.batch)
     train_ds, _ = load_dataset("synthetic", synthetic_size=size, seed=0)
     image_dtype = np.float32 if args.no_half else "bfloat16"
-    resident = ResidentBatches(train_ds, mesh, batch, image_dtype)
+    multiproc = jax.process_count() > 1
+    resident = (_ReplicatedResident(train_ds, mesh, image_dtype) if multiproc
+                else ResidentBatches(train_ds, mesh, batch, image_dtype))
     model = create_model_from_cfg(cfg)
     state = create_train_state(cfg, jax.random.key(0), steps_per_epoch=1,
                                sample_shape=(1, *train_ds.images.shape[1:]))
-    state = place_state(state, mesh)
-    chunk_fn = make_train_chunk(model, None, resident.out_sharding)
+    update_sharding = None
+    if args.sharded_update:
+        from data_diet_distributed_tpu.parallel.mesh import UpdateSharding
+        update_sharding = UpdateSharding(mesh)
+    state = place_state(state, mesh, update_sharding=update_sharding)
+    chunk_fn = make_train_chunk(model, None, resident.out_sharding,
+                                update_sharding)
+    from data_diet_distributed_tpu.parallel.mesh import _device_put
+    rep = resident.images.sharding if multiproc else None
 
     def block(k: int):
         idx = (np.arange(k * batch, dtype=np.int64) % resident.n).astype(
@@ -97,14 +142,23 @@ def profile_train(args) -> None:
 
     def dispatch(state, k: int) -> tuple[float, object]:
         """One chunked dispatch of k steps; the metrics fetch is the barrier
-        (block_until_ready is not reliable on every backend — see bench.py)."""
+        (block_until_ready is not reliable on every backend — see bench.py).
+        Multi-process: the permutation block is device_put replicated (every
+        process holds the identical host array) so the dispatch is a
+        well-formed global computation; the fetch then rides the same
+        cross-process collective path a production multi-host fetch does."""
         import jax.numpy as jnp
         idx, mask = block(k)
         t0 = time.perf_counter()
+        if multiproc:
+            idx, mask = _device_put(idx, rep), _device_put(mask, rep)
+        else:
+            idx, mask = jnp.asarray(idx), jnp.asarray(mask)
         state, metrics = chunk_fn(state, resident.images, resident.labels,
-                                  resident.indices, jnp.asarray(idx),
-                                  jnp.asarray(mask))
-        jax.device_get(metrics)
+                                  resident.indices, idx, mask)
+        jax.device_get(jax.tree.map(
+            lambda x: x if x.is_fully_addressable else np.asarray(
+                x.addressable_shards[0].data), metrics))
         return time.perf_counter() - t0, state
 
     for k in (1, args.k_long):            # compile both program lengths
@@ -115,7 +169,13 @@ def profile_train(args) -> None:
         t1 = min(t1, dt)
         dt, state = dispatch(state, args.k_long)
         tl = min(tl, dt)
-    _report(args, "train.chunk_steps", "step", t1, tl, batch, MAX_CHUNK_STEPS)
+    if jax.process_index() == 0:
+        if jax.process_count() > 1:
+            print(f"nproc={jax.process_count()} (collectives span "
+                  f"processes; comm is inside the quotient)"
+                  + (" sharded_update=on" if args.sharded_update else ""))
+        _report(args, "train.chunk_steps", "step", t1, tl, batch,
+                MAX_CHUNK_STEPS)
 
 
 def profile_score(args) -> None:
@@ -198,13 +258,78 @@ def main() -> None:
                          "chunk size")
     ap.add_argument("--no-half", action="store_true",
                     help="fp32 compute (CPU-lane runs)")
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="train task: run the quotient through a real "
+                         "N-process jax.distributed runtime (each worker "
+                         "gets 4 virtual CPU devices on the CPU lane) so "
+                         "the recommended chunk size includes cross-process "
+                         "collective cost")
+    ap.add_argument("--sharded-update", action="store_true",
+                    help="train task: arm the cross-replica sharded weight "
+                         "update inside the measured chunk program")
+    ap.add_argument("--proc-id", type=int, default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--coordinator", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.k_long < 2:
         raise SystemExit("--k-long must be >= 2 for a difference quotient")
+    if args.task == "score" and args.nproc > 1:
+        # Refuse BEFORE spawning workers: N processes completing a full
+        # distributed init just to print this N times helps nobody.
+        raise SystemExit("--nproc applies to --task train (the chunked "
+                         "score engine is single-process by design)")
+    if args.nproc > 1 and args.proc_id is None:
+        raise SystemExit(_launch_workers(args))
+    if args.proc_id is not None:
+        from data_diet_distributed_tpu.config import MeshConfig
+        from data_diet_distributed_tpu.parallel.mesh import \
+            initialize_multihost
+        initialize_multihost(MeshConfig(
+            multihost=True, coordinator_address=args.coordinator,
+            num_processes=args.nproc, process_id=args.proc_id))
     if args.task == "score":
         profile_score(args)
     else:
         profile_train(args)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_workers(args) -> int:
+    """Spawn ``--nproc`` copies of this invocation joined into one
+    ``jax.distributed`` runtime (worker 0's report is the output). On the
+    CPU lane each worker owns 4 virtual devices — the 2-process test
+    harness's exact shape, so the quotient's collectives ride the same gloo
+    path the multi-host drills pin."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    platforms = env.get("JAX_PLATFORMS", "").lower()
+    if not platforms:
+        # No silent fallback: defaulting to CPU here would hand a TPU-pod
+        # operator a gloo-over-CPU chunk recommendation with nothing in the
+        # output saying the TPU was bypassed — and spawning N local workers
+        # against one TPU claim cannot work anyway (one process per HOST is
+        # the TPU recipe, launched with --proc-id/--coordinator directly).
+        raise SystemExit(
+            "--nproc needs JAX_PLATFORMS pinned: JAX_PLATFORMS=cpu for the "
+            "virtual-device CPU lane (4 devices per worker); on TPU pods "
+            "launch one invocation per host with --proc-id/--coordinator")
+    if "cpu" in platforms:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=4"])
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)]
+        + sys.argv[1:] + ["--proc-id", str(pid), "--coordinator", coordinator],
+        env=env) for pid in range(args.nproc)]
+    # Wait on EVERY worker (a short-circuit would orphan the survivors in a
+    # dead collective when one crashes), then report the first failure.
+    rcs = [p.wait() for p in procs]
+    return next((rc for rc in rcs if rc), 0)
 
 
 if __name__ == "__main__":

@@ -52,6 +52,10 @@ KNOWN_KINDS = frozenset({
     # Live introspection layer (obs/server.py, obs/fleet.py, obs/slo.py):
     # server lifecycle, cross-rank fleet snapshots, SLO violations.
     "obs_server", "fleet_status", "slo_violation",
+    # Pod-scale comm/checkpoint layer (obs/comm.py, checkpoint.py LocalTier):
+    # per-step collective-byte estimates + overlap verdict, and per-save
+    # checkpoint-tier transitions (local -> durable promotion, errors).
+    "comm_stats", "ckpt_tier",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -87,6 +91,13 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "fleet_status": ("n_ranks", "ranks", "stalest_rank", "stalest_age_s",
                      "straggler_rank"),
     "slo_violation": ("slo", "value", "threshold"),
+    # Pod-scale comm/checkpoint records. Null-tolerant like xla_program: the
+    # overlap ratio degrades to null when no link-bandwidth/cost-analysis is
+    # known (CPU lanes) — the KEYS must be present so consumers can rely on
+    # the shape.
+    "comm_stats": ("mesh", "bytes_per_step", "overlap_ratio",
+                   "sharded_update"),
+    "ckpt_tier": ("step", "tier"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
