@@ -176,6 +176,12 @@ class LocalTier:
         self.max_to_keep = int(max_to_keep)
         self.logger = logger
         self.errors: list[str] = []
+        #: The last drain's outcome ({"ok", "wait_s", "budget_s",
+        #: "timed_out", "errors"}) — surfaced through
+        #: ``CheckpointManager.drain_info`` so the preemption path's
+        #: ``checkpoint_not_durable`` fault can report how much of the
+        #: timeout budget the wait consumed (slow disk vs dead promotion).
+        self.last_drain: dict[str, Any] | None = None
         self._pending: list[int] = []
         self._cond = threading.Condition()
         self._stop = False
@@ -344,10 +350,24 @@ class LocalTier:
         failed never appears in ``tier_steps``/``all_steps``, so restore and
         consensus can never trust it."""
         budget = self.drain_timeout_s if timeout_s is None else timeout_s
+        t0 = time.perf_counter()
         with self._cond:
+            meaningful = bool(self._pending)
             ok = self._cond.wait_for(lambda: not self._pending, budget)
+        if meaningful or (self.errors and self.last_drain is None):
+            # Only a drain that actually WAITED (or the FIRST failed one)
+            # is a triage record: the read paths call this on every
+            # listing, and an instant no-op must not clobber the stats of
+            # the wait that mattered — with errors standing, every later
+            # drain is an instant no-op too.
+            self.last_drain = {"ok": bool(ok and not self.errors),
+                               "wait_s": round(time.perf_counter() - t0, 3),
+                               "budget_s": float(budget),
+                               "timed_out": not ok,
+                               "errors": len(self.errors)}
         if not ok:
-            self._log(-1, "error",
+            self._log(-1, "error", wait_s=self.last_drain["wait_s"],
+                      budget_s=float(budget),
                       error=f"drain timed out after {budget}s with "
                             f"{len(self._pending)} promotion(s) in flight")
         return ok and not self.errors
@@ -685,6 +705,57 @@ class CheckpointManager:
             return restored["meta"]
         except KeyError:    # saved without a metrics item — a legitimate None;
             return None     # real IO/corruption errors propagate
+
+    def await_step(self, step: int, timeout_s: float | None = None) -> list[int]:
+        """Bounded wait for ``step`` to appear in the durable LISTING — the
+        preemption path's cross-rank completion gap: each rank's drain
+        covers only its OWN promotions, but a tier step counts only once
+        EVERY rank's marker lands, so a rank that drained fast can list a
+        just-promoted step as absent for the moment its slower peers are
+        still copying. Filesystem polling only (no collective — peers may
+        be mid-teardown), bounded by the tier drain budget; returns the
+        final listing either way. Orbax-only managers return the listing
+        immediately (the Orbax save is itself collective — landing is
+        all-rank by construction)."""
+        steps = self.all_steps()
+        if self._tier is None or step in steps or self._tier.world <= 1:
+            return steps
+        # Waiting is only meaningful for PEERS' markers: if this rank's own
+        # marker is not down (its promotion failed or timed out), no peer
+        # can complete the step — report the honest miss immediately.
+        own = os.path.join(tiered_dir(self.directory), f"step_{int(step)}",
+                           f"promoted.rank{self._tier.rank}.json")
+        if not os.path.exists(own):
+            return steps
+        budget = (self._tier.drain_timeout_s if timeout_s is None
+                  else timeout_s)
+        deadline = time.monotonic() + budget
+        while step not in steps and time.monotonic() < deadline:
+            time.sleep(0.1)
+            steps = self.all_steps()
+        return steps
+
+    def drain_info(self) -> dict[str, Any] | None:
+        """The last tier drain's outcome (None without a tier or before any
+        drain) — how long the durability barrier actually waited against its
+        budget, so a lost durable-step claim can be triaged as slow-disk
+        (budget consumed, timed out) vs dead-promotion (failed fast)."""
+        if self._tier is None:
+            return None
+        return self._tier.last_drain
+
+    def saved_world(self, step: int) -> int | None:
+        """The process count the checkpoint at ``step`` was SAVED by (tier
+        steps record it in every rank manifest; Orbax composites don't —
+        None). The elastic resume path logs it so a recovery onto a
+        different world size is pinned in the stream, not inferred."""
+        try:
+            if int(step) in self._tier_steps():
+                return int(_read_tier_manifests(self.directory,
+                                                int(step))[0]["world"])
+        except (OSError, TypeError, ValueError, KeyError):
+            return None
+        return None
 
     def restore_variables(self, state: "TrainState", step: int | None = None):
         """Params + batch_stats only — what the scoring phase needs (reference loads

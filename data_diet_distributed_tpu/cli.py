@@ -21,7 +21,7 @@ from .obs import (MetricsLogger, ResourceMonitor, plot_metrics,
                   plot_utilization, tracing)
 
 
-def _build(argv: list[str]) -> tuple[str, Config]:
+def _build(argv: list[str]) -> tuple[str, Config, argparse.Namespace]:
     parser = argparse.ArgumentParser(prog="data_diet_distributed_tpu")
     parser.add_argument("command", choices=["run", "train", "score", "sweep"],
                         help="run = score->prune->retrain end-to-end; "
@@ -36,15 +36,43 @@ def _build(argv: list[str]) -> tuple[str, Config]:
     # argparse rejects ("unrecognized arguments" — positionals after an
     # optional can't join an already-consumed nargs=* group).
     args = parser.parse_intermixed_args(argv)
-    return args.command, load_config(args.config, args.overrides)
+    return args.command, load_config(args.config, args.overrides), args
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
     import time
     run_started = time.time()
-    command, cfg = _build(sys.argv[1:] if argv is None else argv)
+    command, cfg, args = _build(sys.argv[1:] if argv is None else argv)
+    from .resilience import elastic as elastic_mod
+    if cfg.elastic.enabled and os.environ.get(elastic_mod.CHILD_ENV) != "1":
+        # Elastic supervisor mode: this process never touches jax — it
+        # spawns `elastic.world` worker ranks of this same invocation
+        # (CHILD_ENV set, so they take the training path below), classifies
+        # their exits, and shrinks/grows/restarts per the elastic policy.
+        # Its elastic_event records and terminal run_summary share the
+        # workers' metrics JSONL (append-only, rank-0-gated on their side).
+        logger = elastic_mod.JsonlLogger(cfg.obs.metrics_path)
+        supervisor = elastic_mod.ElasticSupervisor(
+            cfg, command, config_path=args.config, overrides=args.overrides,
+            logger=logger)
+        mono0 = time.perf_counter()
+        try:
+            rc = supervisor.run()
+        except BaseException:
+            logger.log("run_summary",
+                       wall_s=round(time.perf_counter() - mono0, 3),
+                       exit_class="fatal:supervisor", command=command)
+            logger.close()
+            raise
+        logger.log("run_summary",
+                   wall_s=round(time.perf_counter() - mono0, 3),
+                   exit_class=supervisor.exit_class(rc), command=command,
+                   elastic={"attempts": supervisor.attempt + 1,
+                            "final_world": supervisor.world})
+        logger.close()
+        return rc
     from .resilience import inject
-    from .resilience.preemption import EXIT_PREEMPTED, Preempted
     plan = inject.activate_from_env()
     if plan is not None:
         print(f"[resilience] fault plan armed from DDT_FAULT_PLAN: {plan}",
@@ -83,12 +111,49 @@ def main(argv: list[str] | None = None) -> int:
     if monitor:
         monitor.start()
     logger = MetricsLogger(cfg.obs.metrics_path)
+    mono0 = time.perf_counter()
+    try:
+        rc = _supervised_body(cfg, command, logger, monitor, run_started,
+                              mono0)
+    except BaseException as exc:
+        # Bounded exit under a multi-process runtime: once a peer is dead
+        # (the very thing most fatal exceptions here mean — a collective
+        # torn mid-flight), interpreter teardown wedges in the distributed
+        # client's shutdown barrier. The run_summary/ledger already landed
+        # in the finally below; print the failure and exit NOW with the
+        # documented contract (69 retriable for runtime/collective
+        # failures — restart the job and resume; 1 otherwise) instead of
+        # hanging a supervisor on a zombie. Single-process keeps the
+        # ordinary raise (real tracebacks for real bugs).
+        import jax
+        try:
+            multi = jax.process_count() > 1
+        except Exception:   # noqa: BLE001 — backend dead: judge single-process
+            multi = False
+        if not multi:
+            raise
+        import os
+        import traceback
+        from .resilience.consensus import EXIT_RETRIABLE
+        traceback.print_exc()
+        print("[resilience] fatal under the multi-process runtime — bounded "
+              "exit (teardown with a dead peer can hang)", file=sys.stderr,
+              flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_RETRIABLE if isinstance(exc, RuntimeError) else 1)
+    return rc
+
+
+def _supervised_body(cfg, command: str, logger, monitor, run_started,
+                     mono0) -> int:
+    import time
     from .obs import emit_run_summary
     from .obs.session import ObsSession
+    from .resilience.preemption import EXIT_PREEMPTED, Preempted
     preempted: Preempted | None = None
     final: dict | None = None
     exit_class = "ok"
-    mono0 = time.perf_counter()
     # ObsSession: build + install the unified observability layer — trace
     # spans, metrics registry, per-rank heartbeats, fault flight recorder,
     # XLA compiled-program introspector — for the run's duration (entered
@@ -254,11 +319,11 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> dict | None:
         return res.throughput_summary()
     elif command == "score":
         from .data.pipeline import BatchSharder
-        from .parallel.mesh import is_primary, make_mesh
+        from .parallel.mesh import is_primary, run_mesh
         from .train.loop import (compute_scores, load_data_for,
                                  pipeline_stages, scores_npz_path)
         from .utils.io import atomic_savez
-        mesh = make_mesh(cfg.mesh)
+        mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
         sharder = BatchSharder(mesh)
         train_ds, _ = load_data_for(cfg)
         # Stage-resumable like `run`: per-seed partials under checkpoint_dir;

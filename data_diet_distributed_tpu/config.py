@@ -269,6 +269,44 @@ class CheckpointConfig:
 
 
 @dataclass
+class ElasticConfig:
+    """Elastic pod supervision (``resilience/elastic.py``): survive host
+    loss mid-run, grow back on host join. With ``enabled=true`` the CLI
+    becomes a bounded restart supervisor: it spawns ``world`` worker
+    processes of the same invocation, and on a non-graceful worker death
+    (SIGKILL/OOM — the survivors exit retriably via watchdog/consensus
+    poison) relaunches the job on the surviving world size with
+    ``train.resume=true`` — the stage manifest + multi-tier checkpoints
+    re-enter at the exact stage, with params/opt-state shards remapped to
+    the new device count at restore. A join request
+    (``elastic.request_join`` / the ``rejoin_after_stage`` injection) grows
+    the pod back at the next stage boundary. Every decision is an
+    ``{"kind": "elastic_event"}`` record."""
+
+    enabled: bool = False
+    # Initial worker count; None -> mesh.num_processes or 1. The CLI
+    # supervisor launches all ranks on THIS host (CPU pods, single-host
+    # multi-chip); a per-host launcher reuses ElasticSupervisor with its
+    # own spawn hook on real multi-host pods.
+    world: int | None = None
+    min_world: int = 1               # never shrink below this many ranks
+    max_world: int | None = None     # grow ceiling; None -> initial world
+    max_restarts: int = 5            # failure-relaunch budget (grows are free)
+    backoff_s: float = 2.0           # exponential between failure relaunches
+    # After the first non-graceful death in an attempt, how long surviving
+    # children get to exit on their own (their watchdog/poison escalation)
+    # before the supervisor terminates them.
+    reap_timeout_s: float = 60.0
+    # Heartbeat age past which a rank counts dead for survivor naming.
+    heartbeat_stale_s: float = 30.0
+    # Relaunch (with resume) after a clean preemption exit 75. True fits
+    # the supervised-pod model (an injected/per-worker SIGTERM is the
+    # worker's eviction, not the supervisor's); set false where 75 must
+    # propagate to an outer scheduler.
+    resume_preempted: bool = True
+
+
+@dataclass
 class ResilienceConfig:
     """Fault-tolerance layer (``resilience/``): watchdog, preemption handling,
     checkpoint integrity, NaN sentinel. The reference has none of it — a hung
@@ -454,6 +492,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     def validate(self) -> "Config":
         if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
@@ -546,6 +585,36 @@ class Config:
             raise ValueError(
                 f"checkpoint.promote_delay_s must be >= 0, got "
                 f"{c.promote_delay_s}")
+        e = self.elastic
+        if e.world is not None and e.world < 1:
+            raise ValueError(f"elastic.world must be >= 1, got {e.world}")
+        if e.min_world < 1:
+            raise ValueError(
+                f"elastic.min_world must be >= 1, got {e.min_world}")
+        if e.max_world is not None and e.max_world < e.min_world:
+            raise ValueError(
+                f"elastic.max_world ({e.max_world}) must be >= "
+                f"elastic.min_world ({e.min_world})")
+        if e.world is not None and e.world < e.min_world:
+            raise ValueError(
+                f"elastic.world ({e.world}) must be >= elastic.min_world "
+                f"({e.min_world}) — the supervisor never shrinks below the "
+                "floor, so it cannot start there either")
+        if e.world is not None and e.max_world is not None \
+                and e.world > e.max_world:
+            raise ValueError(
+                f"elastic.world ({e.world}) must be <= elastic.max_world "
+                f"({e.max_world})")
+        if e.max_restarts < 0:
+            raise ValueError(
+                f"elastic.max_restarts must be >= 0, got {e.max_restarts}")
+        if e.backoff_s < 0:
+            raise ValueError(
+                f"elastic.backoff_s must be >= 0, got {e.backoff_s}")
+        if e.reap_timeout_s <= 0 or e.heartbeat_stale_s <= 0:
+            raise ValueError(
+                "elastic.reap_timeout_s and elastic.heartbeat_stale_s must "
+                f"be > 0; got {e.reap_timeout_s}/{e.heartbeat_stale_s}")
         o = self.obs
         if o.snapshot_every_s < 0:
             raise ValueError(
@@ -623,6 +692,7 @@ _TYPE_MAP = {
     "MeshConfig": MeshConfig, "OverlapConfig": OverlapConfig,
     "ParallelConfig": ParallelConfig, "CheckpointConfig": CheckpointConfig,
     "ObsConfig": ObsConfig, "ResilienceConfig": ResilienceConfig,
+    "ElasticConfig": ElasticConfig,
 }
 
 

@@ -289,6 +289,69 @@ def place_state(state, mesh: Mesh, shard_opt_state: bool = False,
                          batch_stats=rest["batch_stats"], step=rest["step"])
 
 
+def run_mesh(cfg_mesh: "MeshConfig | None", elastic: bool = False) -> Mesh:
+    """The run's device mesh. Plain ``make_mesh``, except under elastic
+    supervision: a relaunch after a shrink arrives with whatever
+    ``data_axis`` the operator pinned for the ORIGINAL world, and refusing
+    the surviving device count would turn every recovery into a config
+    error — ``remap_mesh`` recomputes the stale pin instead (the model
+    axis still always refuses)."""
+    return remap_mesh(cfg_mesh) if elastic else make_mesh(cfg_mesh)
+
+
+def remap_mesh(cfg_mesh: MeshConfig | None, devices=None) -> Mesh:
+    """Mesh for a CHANGED device count (elastic shrink/grow): like
+    ``make_mesh``, but a pinned ``data_axis`` that no longer tiles the
+    surviving devices is recomputed instead of refusing — the pin described
+    the old world, and elastic recovery's contract is "run on what remains".
+    The ``model`` axis is never silently changed (tensor-parallel layouts
+    don't survive losing a shard-holder): a device count the model axis
+    cannot tile still raises."""
+    devices = list(devices if devices is not None else jax.devices())
+    model = cfg_mesh.model_axis if cfg_mesh is not None else 1
+    if len(devices) % model:
+        raise ValueError(
+            f"remap_mesh: {len(devices)} surviving devices cannot tile "
+            f"model_axis={model} — tensor-parallel state cannot be remapped "
+            "by dropping a shard-holder")
+    data = cfg_mesh.data_axis if cfg_mesh is not None else None
+    if data is None or data * model != len(devices):
+        data = len(devices) // model
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def remap_state(state, mesh: Mesh, *, shard_opt_state: bool = False,
+                update_sharding: "UpdateSharding | None" = None):
+    """Re-place a TrainState onto a DIFFERENT mesh (elastic shape change):
+    host round-trip of every leaf, then the production ``place_state``
+    placement for the new mesh — params, ZeRO-1 slots, and the sharded
+    weight update's layouts all recompute against the new device count
+    (``_zero1_spec`` re-decides which dims shard, so partial sharding
+    degrades gracefully as the mesh shrinks).
+
+    In-process remap requires fully-addressable leaves (single-process
+    meshes, or a shrink that kept every shard local). Cross-PROCESS shape
+    changes go through checkpoint restore instead (``resilience/elastic.py``
+    restarts the job; ``CheckpointManager.restore`` places tier/Orbax
+    payloads with the new world's template shardings) — re-gathering a dead
+    rank's shards in-process would need the collective the dead rank can no
+    longer join."""
+    def to_host(leaf):
+        if hasattr(leaf, "is_fully_addressable") and \
+                not leaf.is_fully_addressable:
+            raise ValueError(
+                "remap_state needs fully-addressable leaves; a cross-process "
+                "shape change restarts through checkpoint restore "
+                "(resilience/elastic.py), which re-places per-rank shard "
+                "files under the new world's shardings")
+        return np.asarray(leaf) if hasattr(leaf, "shape") else leaf
+
+    host_state = jax.tree.map(to_host, state)
+    return place_state(host_state, mesh, shard_opt_state=shard_opt_state,
+                       update_sharding=update_sharding)
+
+
 def is_primary() -> bool:
     """Process-0 gating for checkpoint/metrics IO (reference: ``if rank == 0``,
     ``ddp.py:105,114,157``)."""

@@ -112,8 +112,12 @@ def broadcast_json(obj):
     buf = np.zeros(n, np.uint8)
     if jax.process_index() == 0:
         buf[:] = payload
+    # astype, not raw tobytes: some jax versions return the broadcast
+    # WIDENED (uint8 -> int32 through the reduction), so reinterpreting the
+    # buffer would interleave zero bytes into the JSON. The values are
+    # exact either way; only the dtype needs normalizing.
     out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
-    return json.loads(out.tobytes().decode())
+    return json.loads(out.astype(np.uint8).tobytes().decode())
 
 
 class SideChannel:

@@ -52,11 +52,23 @@ class FaultPlan:
     hang_seconds: float = 3600.0
     sigterm_at_step: int | None = None     # SIGTERM self before step N (mid-epoch)
     sigterm_at_epoch_end: int | None = None  # SIGTERM self after epoch N
+    # SIGKILL self after epoch N — NON-graceful, unlike the SIGTERM classes:
+    # no handler runs, no final checkpoint, no agreed exit. The host-loss
+    # injection the elastic path (resilience/elastic.py) must survive: peers
+    # detect the dead rank via watchdog/poison and the supervisor shrinks
+    # the world. Rank-targetable like every class.
+    kill_rank_after_epoch: int | None = None
     truncate_after_save_step: int | None = None  # corrupt the ckpt saved at step N
     nan_loss_at_epoch: int | None = None   # replace epoch N's train loss with NaN
     # SIGTERM self after N total seed score passes have persisted partials
     # (the mid-scoring preemption drill: at most one seed's pass is lost).
     sigterm_after_seed_scores: int | None = None
+    # When the named pipeline stage completes, write an elastic JOIN request
+    # (resilience/elastic.request_join) next to the stage manifest — the
+    # host-rejoin drill: the supervisor grows the pod back at the next
+    # stage boundary. A stage NAME (e.g. "score", "retrain:final"), not an
+    # index, matching the stage-manifest vocabulary.
+    rejoin_after_stage: str | None = None
     # Drop the newest entry from this rank's durable-candidate list at
     # consensus restore — as if its final async save never landed (the
     # divergent-latest-checkpoint drill).
@@ -108,6 +120,22 @@ class FaultInjector:
         elif site == "epoch_end":
             if self._due("sigterm_at_epoch_end", ctx["epoch"]):
                 os.kill(os.getpid(), signal.SIGTERM)
+            if self._due("kill_rank_after_epoch", ctx["epoch"]):
+                # Non-graceful by construction: SIGKILL cannot be handled,
+                # so no drain, no final save, no lockstep exit — the
+                # injected twin of a host loss / OOM kill.
+                os.kill(os.getpid(), signal.SIGKILL)
+        elif site == "stage_done":
+            if self._due("rejoin_after_stage", ctx["stage"]):
+                from .elastic import (checkpoint_dir_from_manifest,
+                                      request_join)
+                # The join request a supervisor translates into a
+                # stage-boundary resize, addressed by the one path the
+                # stage layer holds at fire time.
+                request_join(
+                    checkpoint_dir_from_manifest(ctx["manifest_path"]),
+                    ranks=1,
+                    reason=f"injected rejoin after {ctx['stage']}")
         elif site == "seed_scored":
             if self._due("sigterm_after_seed_scores", ctx["completed"]):
                 os.kill(os.getpid(), signal.SIGTERM)

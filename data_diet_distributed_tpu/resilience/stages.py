@@ -146,6 +146,12 @@ class StageManifest:
         self._data["stages"][stage] = entry
         self._write()
         self._log(stage, status)
+        if status == "done":
+            # Injection site for stage-completion faults (the elastic
+            # rejoin drill): AFTER the durable mark, so a fault fired here
+            # can never lose the stage it follows.
+            from . import inject
+            inject.fire("stage_done", stage=stage, manifest_path=self.path)
 
 
 class ScorePartialStore:

@@ -45,12 +45,13 @@ from ..obs import slo as obs_slo
 from ..obs import xla as obs_xla
 from ..obs.profiler import ProfileWindow
 from ..ops.scoring import score_dataset
-from ..parallel.mesh import (is_primary, make_mesh, place_state, replicate,
-                             resolve_update_sharding)
+from ..parallel.mesh import (is_primary, place_state, replicate,
+                             resolve_update_sharding, run_mesh)
 from ..pruning import (build_prune_manifest, select_indices,
                        verify_prune_manifest, write_prune_manifest)
 from ..resilience import inject
 from ..resilience.consensus import Consensus
+from ..resilience.elastic import stage_barrier
 from ..resilience.preemption import Preempted, PreemptionHandler
 from ..resilience.sentinel import DivergenceError, LossSentinel
 from ..resilience.stages import (ScorePartialStore, StageManifest,
@@ -250,7 +251,8 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     eval — the attachment point for cross-epoch observers such as the
     forgetting-events tracker (``forgetting_scores``)."""
     cfg = _with_epochs(cfg, num_epochs, seed)
-    mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+    mesh = mesh if mesh is not None else run_mesh(cfg.mesh,
+                                                  elastic=cfg.elastic.enabled)
     sharder = sharder or BatchSharder(mesh)
     logger = logger or MetricsLogger(None, echo=False)
 
@@ -344,8 +346,13 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                             "resume=false")
                 else:
                     start_epoch = int(state.step) // steps_per_epoch
+                # saved_world: the process count that WROTE the restored
+                # step (tier manifests record it) — an elastic recovery
+                # onto a different world size is pinned in the stream.
                 logger.log("resume", tag=tag, step=int(state.step),
-                           epoch=start_epoch)
+                           epoch=start_epoch,
+                           world=jax.process_count(),
+                           saved_world=ckpt.saved_world(used_step))
     except Exception:
         if ckpt is not None:   # refuse-to-resume must not leak the async manager
             ckpt.close()
@@ -472,14 +479,26 @@ def _preempt_exit(preempt, ckpt, state, logger, tag, epoch, steps_per_epoch,
                 saved_steps.append(step)
             durable = step
         # Durability barrier: async Orbax saves land / tier promotions
-        # drain. The claim below must then match the LISTING — a failed or
-        # timed-out tier promotion leaves the step off it, and reporting it
-        # durable anyway would make the orchestrator resume into a loss
-        # (the Orbax path raises at the barrier; the tier path reports).
-        landed = ckpt.all_steps()
+        # drain — plus a bounded cross-RANK wait (await_step): this rank's
+        # drain covers only its own promotions, and a tier step counts only
+        # once every peer's marker lands. The claim below must then match
+        # the LISTING — a failed or timed-out tier promotion leaves the
+        # step off it, and reporting it durable anyway would make the
+        # orchestrator resume into a loss (the Orbax path raises at the
+        # barrier; the tier path reports).
+        landed = (ckpt.await_step(durable) if durable is not None
+                  else ckpt.all_steps())
         if durable is not None and durable not in landed:
+            # Triage fields: how much of the drain budget the barrier
+            # actually consumed — a timed-out wait at full budget is a slow
+            # disk, a fast failure is a dead promotion (distinct soak
+            # verdicts; the tier also logged the per-step ckpt_tier error).
+            drain = ckpt.drain_info() or {}
             logger.fault("checkpoint_not_durable", tag=tag, step=durable,
-                         durable_steps=landed[-3:])
+                         durable_steps=landed[-3:],
+                         drain_wait_s=drain.get("wait_s"),
+                         drain_budget_s=drain.get("budget_s"),
+                         drain_timed_out=drain.get("timed_out"))
             durable = None
     logger.log("preempted", tag=tag, signal=preempt.signame, step=step,
                epoch=epoch, durable_step=durable)
@@ -1478,7 +1497,7 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
     """
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
     sweep = sweep_levels(cfg)
-    mesh = make_mesh(cfg.mesh)
+    mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
     stages = pipeline_stages(cfg, logger)
@@ -1492,6 +1511,11 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
 
     summaries = []
     for sparsity in sweep:
+        # Elastic barrier: a pending pod resize (host join, operator
+        # resize) is honored HERE, between levels — the cleanest durable
+        # point; the relaunched world's stage-resume skips finished levels.
+        stage_barrier(cfg, logger,
+                      boundary=f"retrain:final_{sweep_suffix(sparsity)}")
         summaries.append(_retrain_level(
             cfg, train_ds, test_ds, scores, float(sparsity), mesh=mesh,
             sharder=sharder, logger=logger,
@@ -1514,7 +1538,7 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
     preempted (exit 75) or crashed run re-invoked with the same config
     re-enters at the exact stage instead of re-scoring from seed 0."""
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
-    mesh = make_mesh(cfg.mesh)
+    mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
     sharder = BatchSharder(mesh)
     train_ds, test_ds = load_data_for(cfg)
     stages = pipeline_stages(cfg, logger)
@@ -1524,6 +1548,8 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
         scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
                                          sharder=sharder, logger=logger,
                                          stages=stages)
+        # Elastic barrier at the score→retrain boundary (see run_sweep).
+        stage_barrier(cfg, logger, boundary="retrain:final")
         return _retrain_level(cfg, train_ds, test_ds, scores,
                               cfg.prune.sparsity, mesh=mesh, sharder=sharder,
                               logger=logger,

@@ -1,8 +1,12 @@
-"""Worker process for the 2-process ``jax.distributed`` tests (not a pytest file).
+"""Worker process for the multi-process ``jax.distributed`` tests (not a
+pytest file).
 
 Launched as ``python multihost_worker.py <pid> <nprocs> <coordinator> <out_dir>
-[model_axis] [scenario]``. Each process owns 4 virtual CPU devices; together
-they form the 8-device mesh every other test uses single-process. The default
+[model_axis] [scenario]``. Each process owns 4 virtual CPU devices; at the
+historical ``nprocs=2`` they form the same 8-device mesh every other test
+uses single-process, and the consensus scenarios scale their geometry with
+``jax.process_count()`` so the same step-index assertions pin the same
+claims at 3 and 4 processes (ISSUE 11's >2-rank graduation). The default
 ``baseline`` scenario drives the PRODUCTION code paths whose
 ``process_count() > 1`` branches had zero coverage through round 2 (VERDICT r2
 #2):
@@ -280,16 +284,25 @@ def consensus_scenario(scenario: str, pid: int, out_dir: str) -> None:
     from data_diet_distributed_tpu.resilience.watchdog import WatchdogTimeout
     from data_diet_distributed_tpu.train.loop import fit
 
+    # Geometry scales with the process count (2 procs reproduces the
+    # historical 256/64 exactly): batch = 32*world over 4*world devices,
+    # dataset = 4 batches -> every scenario keeps 4 steps/epoch, so the
+    # step-4/8/12 assertions hold at ANY world size. The consensus
+    # machinery itself is world-size-free (allgather + intersect).
+    world = jax.process_count()
+    batch, size = 32 * world, 128 * world
     overrides = [
-        "data.dataset=synthetic", "data.synthetic_size=256",
-        "data.batch_size=64", "data.eval_batch_size=64",
+        "data.dataset=synthetic", f"data.synthetic_size={size}",
+        f"data.batch_size={batch}", f"data.eval_batch_size={batch}",
         "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=3",
         "train.half_precision=false", "train.device_resident_data=false",
         "train.log_every_steps=1000", "train.checkpoint_every=1",
         f"train.checkpoint_dir={out_dir}/ckpt",
         f"obs.metrics_path={out_dir}/metrics.jsonl",
-        "resilience.consensus_grace_s=8",
-        "score.pretrain_epochs=0", "score.batch_size=64",
+        # >2 procs share one oversubscribed core in the harness: give the
+        # watchdog-armed lanes a little more compile headroom.
+        f"resilience.consensus_grace_s={8 if world <= 2 else 10}",
+        "score.pretrain_epochs=0", f"score.batch_size={batch}",
     ]
     plan = None
     if scenario == "sigterm_rank1":
@@ -302,7 +315,8 @@ def consensus_scenario(scenario: str, pid: int, out_dir: str) -> None:
         plan = inject.FaultPlan(rank=1, nan_loss_at_epoch=1)
     elif scenario == "hang_rank1":
         plan = inject.FaultPlan(rank=1, hang_at=5, hang_seconds=600.0)
-        overrides += ["resilience.step_timeout_s=8", "train.num_epochs=2"]
+        overrides += [f"resilience.step_timeout_s={8 if world <= 2 else 12}",
+                      "train.num_epochs=2"]
     elif scenario == "divergent_restore_seed":
         overrides += ["train.num_epochs=2"]
     elif scenario == "divergent_restore_resume":
@@ -331,7 +345,7 @@ def consensus_scenario(scenario: str, pid: int, out_dir: str) -> None:
     cfg = load_config(None, overrides)
     mesh = make_mesh(None)
     sharder = BatchSharder(mesh)
-    train_ds, _ = load_dataset("synthetic", synthetic_size=256, seed=0)
+    train_ds, _ = load_dataset("synthetic", synthetic_size=size, seed=0)
     logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
     if plan is not None:
         inject.activate(plan)

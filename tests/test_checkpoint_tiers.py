@@ -183,6 +183,56 @@ def test_preempt_with_unpromotable_save_reports_no_durable_step(
     assert preempted and preempted[-1]["durable_step"] is None
 
 
+def test_drain_timeout_fault_reports_budget_consumed(tmp_path, mesh8):
+    """Soak-triage fix (ISSUE 11 satellite): when the preemption drain loses
+    the durable-step claim, the checkpoint_not_durable fault must say how
+    much of the drain budget the barrier actually consumed — a timed-out
+    wait at full budget is a slow disk; a fast failure is a dead promotion.
+    Here: promotion sleeps past a tiny budget, so the drain TIMES OUT with
+    ~the whole budget consumed."""
+    cfg = _tiny_cfg(tmp_path, **{"checkpoint.promote_delay_s": "8",
+                                 "checkpoint.drain_timeout_s": "1.5",
+                                 "train.num_epochs": 3})
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    inject.activate(inject.FaultPlan(sigterm_at_epoch_end=0))
+    try:
+        with pytest.raises(Preempted) as exc:
+            _fit(cfg, mesh8, logger)
+    finally:
+        inject.deactivate()
+        logger.close()
+    assert exc.value.durable_step is None   # nothing promoted in budget
+    recs = [json.loads(ln) for ln in open(cfg.obs.metrics_path)]
+    fault = next(r for r in recs if r.get("kind") == "fault"
+                 and r.get("fault") == "checkpoint_not_durable")
+    assert fault["drain_timed_out"] is True
+    assert fault["drain_budget_s"] == 1.5
+    # The wait really consumed the budget (slow-disk signature), within
+    # scheduler slop.
+    assert 1.0 <= fault["drain_wait_s"] <= 10.0
+
+
+def test_instant_drains_never_clobber_the_meaningful_drain_record(tmp_path):
+    """With promotion errors standing, every later drain is an instant
+    no-op — it must not overwrite the stats of the drain that actually
+    waited (the slow-disk vs dead-promotion triage signal), and the FIRST
+    failed drain must still land a record when none exists yet."""
+    from data_diet_distributed_tpu.checkpoint import LocalTier
+    tier = LocalTier(str(tmp_path / "ckpt"))
+    try:
+        tier.errors.append("promotion failed")
+        assert tier.drain(0.05) is False     # first failure: records
+        first = tier.last_drain
+        assert first is not None and first["ok"] is False
+        meaningful = dict(first, wait_s=1.2, timed_out=True)
+        tier.last_drain = meaningful
+        assert tier.drain(0.05) is False     # instant no-op: keeps it
+        assert tier.last_drain is meaningful
+        assert tier.last_drain["wait_s"] == 1.2
+    finally:
+        tier.close()
+
+
 def test_local_tier_dir_namespaces_a_shared_configured_root():
     """Two jobs sharing one configured local SSD root must get disjoint
     scratch trees (a collision lets one run's promoter copy the OTHER run's

@@ -48,6 +48,7 @@ def _free_port() -> int:
 # or gloo's TCP pair aborts mid-frame under load. One retry, gated on these
 # exact signatures — an assertion-class failure never retries.
 _INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "enforce fail at external/gloo",
                            "Shutdown barrier has failed")
 
 
@@ -56,8 +57,9 @@ def _infra_crash(scenario_outs, rcs) -> bool:
                for rc, out in zip(rcs, scenario_outs))
 
 
-def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=True):
-    """Run the 2-process harness in ``scenario`` mode; returns
+def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=True,
+            nprocs: int = 2):
+    """Run the ``nprocs``-process harness in ``scenario`` mode; returns
     (returncodes, results-by-pid (None when a rank died before writing),
     wall seconds). Retries ONCE on the environmental crash signatures
     above."""
@@ -67,11 +69,11 @@ def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=True):
     t0 = time.monotonic()
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), "2", coordinator, str(out_dir),
-             "1", scenario],
+            [sys.executable, worker, str(pid), str(nprocs), coordinator,
+             str(out_dir), "1", scenario],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     for p in procs:
@@ -86,14 +88,15 @@ def _launch(out_dir, scenario: str, timeout_s: float = 600.0, _retry=True):
     rcs = [p.returncode for p in procs]
     if _retry and _infra_crash(outs, rcs):
         print(f"--- {scenario}: environmental crash (rcs={rcs}); one retry")
-        for pid in range(2):   # a half-written pair must not satisfy asserts
+        for pid in range(nprocs):  # a half-written set must not satisfy asserts
             try:
                 os.remove(os.path.join(str(out_dir), f"result_{pid}.json"))
             except FileNotFoundError:
                 pass
-        return _launch(out_dir, scenario, timeout_s, _retry=False)
+        return _launch(out_dir, scenario, timeout_s, _retry=False,
+                       nprocs=nprocs)
     results = []
-    for pid in range(2):
+    for pid in range(nprocs):
         path = os.path.join(str(out_dir), f"result_{pid}.json")
         try:
             with open(path) as fh:
@@ -183,5 +186,87 @@ def test_divergent_latest_checkpoint_restores_min_agreed(tmp_path):
         # Restored the agreed step 4 (end of epoch 0) on BOTH ranks: exactly
         # epoch 1 re-runs. A rank trusting its local latest (8) would have
         # run nothing — and desynced the other rank's collectives.
+        assert r["epochs_run"] == [1]
+        assert r["final_step"] == 8
+
+
+# --------------------------------------------- beyond 2 processes (ISSUE 11)
+#
+# Every drill above ran at exactly 2 ranks since PR 2; nothing in the
+# agreement machinery is allowed to assume that. The worker scales its
+# geometry with jax.process_count() (batch = 32*world over 4*world virtual
+# devices, 4 steps/epoch always), so the same step-index assertions pin the
+# same claims at 3 and 4 ranks: rank-1 faults must drag EVERY peer — not
+# just "the other rank" — into the same lockstep exit.
+
+
+def test_rank1_sigterm_3proc_preempts_all_ranks_and_resumes(tmp_path):
+    rcs, results, wall = _launch(tmp_path, "sigterm_rank1", timeout_s=540,
+                                 nprocs=3)
+    assert wall < 540
+    assert rcs == [EXIT_PREEMPTED] * 3, (rcs, results)
+    for r in results:
+        assert r is not None and r["outcome"] == "preempted", results
+    assert len({r["durable_step"] for r in results}) == 1
+    assert results[0]["durable_step"] == 4
+    assert len({r["step"] for r in results}) == 1
+
+    rcs, results, _ = _launch(tmp_path, "resume_after_preempt",
+                              timeout_s=540, nprocs=3)
+    assert rcs == [0, 0, 0], (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed"
+        assert r["epochs_run"] == [1, 2]
+        assert r["final_step"] == 12
+
+
+def test_rank1_nan_3proc_diverges_in_lockstep(tmp_path):
+    """The OR-reduced verdict at 3 ranks: ONE rank's NaN fails all three at
+    the same epoch; both finite-loss ranks carry remote=True provenance."""
+    rcs, results, wall = _launch(tmp_path, "nan_rank1", timeout_s=540,
+                                 nprocs=3)
+    assert wall < 540
+    assert rcs == [EXIT_DIVERGED] * 3, (rcs, results)
+    by_pid = {r["pid"]: r for r in results if r is not None}
+    assert len(by_pid) == 3
+    assert all(by_pid[p]["outcome"] == "divergence" for p in range(3))
+    assert len({by_pid[p]["epoch"] for p in range(3)}) == 1
+    assert by_pid[0]["remote"] is True and by_pid[2]["remote"] is True
+    assert by_pid[1]["remote"] is False   # rank 1 held the injected NaN
+
+
+def test_rank1_hang_3proc_poisons_all_peers_bounded(tmp_path):
+    """Poison escalation at 3 ranks: the hanging rank's watchdog poisons the
+    side-channel and EVERY peer (not just one) aborts bounded — nobody
+    waits out the 600 s injected hang."""
+    rcs, results, wall = _launch(tmp_path, "hang_rank1", timeout_s=420,
+                                 nprocs=3)
+    assert wall < 420
+    by_pid = {r["pid"]: r for r in results if r is not None}
+    assert rcs[1] == EXIT_RETRIABLE, (rcs, results)
+    assert by_pid[1]["outcome"] == "aborted"
+    assert "WatchdogTimeout" in by_pid[1]["error"]
+    # Both peers exit retriably-or-fatally, but BOUNDED and non-zero.
+    assert rcs[0] != 0 and rcs[2] != 0, (rcs, results)
+    for peer in (0, 2):
+        if rcs[peer] == EXIT_RETRIABLE and by_pid.get(peer) is not None:
+            assert by_pid[peer]["outcome"] == "aborted"
+
+
+def test_divergent_latest_checkpoint_4proc_restores_min_agreed(tmp_path):
+    """agree_restore_step at 4 ranks: with rank 1's newest durable step
+    hidden, all FOUR ranks intersect down to step 4 and re-run epoch 1 —
+    the allgather+intersect is genuinely N-way, not pairwise."""
+    rcs, results, _ = _launch(tmp_path, "divergent_restore_seed",
+                              timeout_s=540, nprocs=4)
+    assert rcs == [0] * 4, (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed" and r["final_step"] == 8
+
+    rcs, results, _ = _launch(tmp_path, "divergent_restore_resume",
+                              timeout_s=540, nprocs=4)
+    assert rcs == [0] * 4, (rcs, results)
+    for r in results:
+        assert r["outcome"] == "completed"
         assert r["epochs_run"] == [1]
         assert r["final_step"] == 8

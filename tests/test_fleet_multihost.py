@@ -30,6 +30,7 @@ import pytest
 # Shared with test_multihost.py: environmental crash signatures (CPU-
 # oversubscription heartbeat timeouts / gloo TCP aborts) retried ONCE.
 _INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "enforce fail at external/gloo",
                            "Shutdown barrier has failed")
 
 

@@ -33,6 +33,7 @@ def _free_port() -> int:
 # heartbeat timeouts / gloo TCP aborts) — retried ONCE; real failures never
 # match and stay loud. Shared rationale with test_consensus_multihost.py.
 _INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "enforce fail at external/gloo",
                            "Shutdown barrier has failed")
 
 

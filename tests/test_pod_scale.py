@@ -38,6 +38,7 @@ def _free_port() -> int:
 # Environmental crash signatures — retried ONCE; same rationale as
 # test_multihost.py / test_consensus_multihost.py.
 _INFRA_CRASH_SIGNATURES = ("heartbeat timeout", "gloo::EnforceNotMet",
+                           "enforce fail at external/gloo",
                            "Shutdown barrier has failed")
 
 

@@ -1,0 +1,66 @@
+"""Fault-injecting soak driver (tools/imagenet_soak.py --smoke) — the
+bounded tier-1 lane of the elastic long-haul soak.
+
+One driver invocation runs the full smoke schedule (SIGTERM preemption →
+NaN divergence → SIGKILL host loss) over supervised CLI cycles and judges
+every cycle by the ``run_monitor --once`` exit contract plus the stream
+schema. The test asserts the driver's own verdict AND re-derives the
+pieces: every cycle recovered, every monitor verdict was 0 (healthy), the
+kill cycle actually went through the supervisor (elastic events), and the
+``soak_report`` record validates against the registered schema. The 0/1/2
+monitor contract's unreachable arm is pinned cheaply against a missing
+stream.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_soak_smoke_recovers_all_faults(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "imagenet_soak.py"),
+         "--smoke", "--workdir", str(tmp_path / "soak"), "--quiet"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert report["cycles"] == 3
+    assert report["faults"] == ["sigterm", "nan", "kill"]
+    assert report["recovered"] == 3
+    # Every cycle judged healthy by the run_monitor CI contract.
+    assert report["monitor_exits"] == [0, 0, 0]
+    by_fault = {c["fault"]: c for c in report["per_cycle"]}
+    # The kill was non-graceful: recovery went through the supervisor
+    # relaunch (2 attempts), not an in-process retry.
+    assert by_fault["kill"]["attempts"] >= 2
+    assert "launch" in by_fault["kill"]["elastic_events"]
+    # SLO engine verdicts rode every cycle's terminal run_summary.
+    for c in report["per_cycle"]:
+        assert c["slo"] is not None and c["slo"]["ok"] is True, c
+        assert c["stream_problems"] == [], c
+        assert c["exit_class"] == "ok", c
+
+    # The driver's own stream carries a schema-valid soak_report.
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from validate_metrics import validate_file
+    soak_stream = tmp_path / "soak" / "soak.jsonl"
+    problems = validate_file(str(soak_stream))
+    assert not problems, problems
+    kinds = [json.loads(ln)["kind"] for ln in open(soak_stream)]
+    assert kinds[-1] == "soak_report"
+
+    # Contract sanity, third arm: no server AND no readable artifacts -> 2.
+    monitor = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_monitor.py"),
+         "--metrics", str(tmp_path / "absent.jsonl"), "--once"],
+        capture_output=True, text=True, timeout=60)
+    assert monitor.returncode == 2

@@ -1,7 +1,29 @@
-"""ImageNet-scale ingestion soak (VERDICT r4 next #8): run BASELINE config 5's
-geometry — ResNet-50, 96x96 images, 100 classes, >=200k rows — through the
-memory-mapped ``.npy`` pipeline beyond the multichip dryrun, and measure what
-the round-4 work only pinned structurally:
+"""ImageNet-scale soak driver: ingestion benchmark AND fault-injecting
+long-haul soak.
+
+**Soak mode** (``--soak`` / ``--smoke``) is the elastic pod's proof harness
+(ROADMAP "Elastic pod"): it runs the production CLI under the
+``resilience/elastic.ElasticSupervisor`` for a schedule of injected faults —
+SIGTERM preemptions, rank-targeted SIGKILL host kills, NaN losses, hang
+stalls, host rejoins — one fault per cycle, each cycle judged by
+``tools/run_monitor.py --once`` exit codes (0 healthy / 1 SLO-violated /
+2 unreachable-or-stale) and the SLO engine's verdict in the terminal
+``run_summary``. The driver emits one ``{"kind": "soak_report"}`` record
+(and prints it as the final JSON line); exit 0 iff every cycle recovered
+and every monitor verdict was healthy.
+
+* ``--smoke``: the bounded tier-1 mode — ≤60 s on CPU, single-host,
+  schedule ``sigterm,nan,kill`` over a tiny synthetic ``train`` workload.
+* ``--soak``: the long-haul mode — hours of ``run`` pipeline cycles at
+  ``--world`` processes with the full ``sigterm,nan,kill,rejoin,hang``
+  schedule, on synthetic or (``--rows``-scale, via the legacy generator)
+  ImageNet-geometry npz data. Recipe in SCALING.md "Elastic pod".
+
+**Ingestion mode** (the default, unchanged — VERDICT r4 next #8): run
+BASELINE config 5's geometry — ResNet-50, 96x96 images, 100 classes,
+>=200k rows — through the memory-mapped ``.npy`` pipeline beyond the
+multichip dryrun, and measure what the round-4 work only pinned
+structurally:
 
 * **ingestion throughput**: a full epoch of production batch assembly
   (C++ gather + lazy uint8 normalization + device upload) over the mmap;
@@ -93,6 +115,203 @@ def generate(data_dir: str, rows: int, image_size: int, classes: int,
     return time.perf_counter() - t0
 
 
+# --------------------------------------------------------------- soak mode
+
+#: fault name -> DDT_FAULT_PLAN payload (rank-targeted at world > 1 so the
+#: drill kills a NON-primary host while rank 0 survives to tell the story).
+#: Coordinates assume the cycle workloads below (checkpoint_every=1, >= 2
+#: epochs): every fault lands after at least one durable step exists.
+FAULTS = {
+    "none": None,
+    "sigterm": {"sigterm_at_epoch_end": 0},
+    # Kill after epoch 1, not 0: epoch 0's checkpoint promotion then has a
+    # whole epoch to land, so the relaunch exercises a real tier RESTORE
+    # (a kill racing the very first promotion may leave nothing durable —
+    # recovery still works, but from scratch, which proves less).
+    "kill": {"kill_rank_after_epoch": 1},
+    "nan": {"nan_loss_at_epoch": 1},
+    "hang": {"hang_at": 3, "hang_seconds": 600.0},
+    "rejoin": {"rejoin_after_stage": "score"},
+}
+
+SMOKE_SCHEDULE = "sigterm,nan,kill"
+SOAK_SCHEDULE = "sigterm,nan,kill,rejoin,hang,none"
+
+
+def _cycle_overrides(args, cycle_dir: str, fault: str) -> list[str]:
+    """The cycle's CLI overrides — a real production invocation, tiny in
+    smoke mode, ``--rows``-scale otherwise."""
+    ckpt = os.path.join(cycle_dir, "ckpt")
+    over = [
+        f"train.checkpoint_dir={ckpt}",
+        f"obs.metrics_path={os.path.join(cycle_dir, 'metrics.jsonl')}",
+        "train.checkpoint_every=1", "train.log_every_steps=1000",
+        "train.half_precision=false",
+        # The multi-tier path IS the elastic restore story: fast local
+        # saves, digest-verified promotion, restorable at any world size.
+        "checkpoint.local_tier=true",
+        # Watchdog + SLO engine armed: a hang cycle must convert to a
+        # retriable failure, and every run_summary must carry an SLO
+        # verdict for the report.
+        f"resilience.step_timeout_s={args.step_timeout}",
+        f"obs.slo_heartbeat_stale_s={max(30.0, 2 * args.step_timeout)}",
+        # In-process recovery for faults that don't kill the process (NaN
+        # rollback, watchdog timeout) — the supervisor covers the rest.
+        "train.auto_resume_retries=1",
+        # Elastic supervision (children read these too: stage barriers).
+        "elastic.enabled=true",
+        f"elastic.world={args.world}",
+        # Strictly above the starting world: a rejoin cycle must have room
+        # to GROW, or the injected join is denied and the drill proves
+        # nothing.
+        f"elastic.max_world={max(args.world + 1, 2)}",
+        "elastic.backoff_s=0.2",
+        f"elastic.reap_timeout_s={max(20.0, 2 * args.step_timeout)}",
+        f"elastic.heartbeat_stale_s={max(20.0, 2 * args.step_timeout)}",
+        f"elastic.max_restarts={args.max_restarts}",
+    ]
+    if args.smoke:
+        over += [
+            "data.dataset=synthetic", "data.synthetic_size=128",
+            "data.batch_size=64", "data.eval_batch_size=64",
+            "model.arch=tiny_cnn", "optim.lr=0.05", "train.num_epochs=3",
+            "score.pretrain_epochs=0", "score.batch_size=64",
+        ]
+    else:
+        over += [
+            "data.dataset=npz", f"data.data_dir={args.data_dir}",
+            f"data.batch_size={args.batch}", f"model.arch={args.arch}",
+            "model.stem=imagenet", f"train.num_epochs={args.epochs}",
+            "prune.sparsity=0.5", "score.pretrain_epochs=1",
+            f"score.method={args.score_method}",
+        ]
+    return over
+
+
+def _judge_cycle(cycle_dir: str) -> dict:
+    """``run_monitor --once --json`` over the cycle's metrics stream (files
+    mode: a finished run is judged by its records) + the stream's schema
+    validation — the soak's per-cycle verdict."""
+    import subprocess
+    metrics = os.path.join(cycle_dir, "metrics.jsonl")
+    monitor = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "run_monitor.py")
+    proc = subprocess.run(
+        [sys.executable, monitor, "--metrics", metrics, "--once", "--json"],
+        capture_output=True, text=True, timeout=60)
+    try:
+        view = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        view = {"error": f"unparseable monitor output: {proc.stdout[-200:]}"}
+    from validate_metrics import validate_file
+    try:
+        problems = validate_file(metrics)
+    except OSError as err:
+        problems = [f"{metrics}: unreadable ({err})"]
+    summary = view.get("run_summary") or {}
+    return {
+        "monitor_exit": proc.returncode,
+        "exit_class": summary.get("exit_class"),
+        "slo": summary.get("slo"),
+        "violations": len(view.get("violations") or []),
+        "stream_problems": problems[:5],
+    }
+
+
+def soak_main(args) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.resilience.elastic import (
+        ElasticSupervisor, JsonlLogger)
+
+    if args.smoke:
+        # The bounded CPU lane: pin the platform for every child; a TPU
+        # host running the smoke must not claim chips for a 60 s drill.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    else:
+        # Long-haul cycles run the ImageNet-geometry npz workload; generate
+        # it once (chunked, uint8 — never float32-resident) when absent,
+        # exactly like the ingestion mode.
+        have = all(os.path.exists(os.path.join(args.data_dir, f))
+                   for f in ("train_images.npy", "train_labels.npy",
+                             "test_images.npy", "test_labels.npy",
+                             "stats.npz"))
+        if not have:
+            generate(args.data_dir, args.rows, args.image_size,
+                     args.classes, args.seed)
+    schedule = [f.strip() for f in
+                (args.schedule or (SMOKE_SCHEDULE if args.smoke
+                                   else SOAK_SCHEDULE)).split(",") if f.strip()]
+    unknown = [f for f in schedule if f not in FAULTS]
+    if unknown:
+        raise SystemExit(f"unknown fault(s) {unknown}; known: "
+                         f"{sorted(FAULTS)}")
+    if args.cycles:
+        schedule = (schedule * args.cycles)[: args.cycles]
+    os.makedirs(args.workdir, exist_ok=True)
+    driver_log = JsonlLogger(os.path.join(args.workdir, "soak.jsonl"),
+                             echo=not args.quiet)
+    t0 = time.perf_counter()
+    cycles = []
+    deadline = (time.monotonic() + args.duration) if args.duration else None
+    for i, fault in enumerate(schedule):
+        if deadline is not None and time.monotonic() > deadline:
+            break
+        cycle_dir = os.path.join(args.workdir, f"cycle{i}_{fault}")
+        os.makedirs(cycle_dir, exist_ok=True)
+        overrides = _cycle_overrides(args, cycle_dir, fault)
+        cfg = load_config(None, overrides)
+        plan = FAULTS[fault]
+        if plan is not None and args.world > 1:
+            plan = dict(plan, rank=1)   # kill/stall a NON-primary host
+
+        def fault_env(attempt: int, plan=plan):
+            # Attempt 0 only: a relaunched attempt must not re-trip the
+            # fault it is recovering from (exact-coordinate plans can
+            # re-fire when resume replays the faulted unit).
+            if attempt == 0 and plan is not None:
+                return {"DDT_FAULT_PLAN": json.dumps(plan)}
+            return {"DDT_FAULT_PLAN": ""}
+
+        cycle_log = JsonlLogger(cfg.obs.metrics_path, echo=False)
+        supervisor = ElasticSupervisor(
+            cfg, args.command, overrides=overrides, logger=cycle_log,
+            fault_env=fault_env)
+        c0 = time.perf_counter()
+        try:
+            rc = supervisor.run()
+        finally:
+            cycle_log.close()
+        wall = round(time.perf_counter() - c0, 1)
+        verdict = _judge_cycle(cycle_dir)
+        rec = {
+            "cycle": i, "fault": fault, "supervisor_rc": rc,
+            "attempts": supervisor.attempt + 1,
+            "final_world": supervisor.world, "wall_s": wall,
+            "elastic_events": [e["event"] for e in supervisor.events],
+            **verdict,
+        }
+        rec["recovered"] = bool(rc == 0 and verdict["monitor_exit"] == 0
+                                and not verdict["stream_problems"])
+        cycles.append(rec)
+        driver_log.log("elastic_event", event="soak_cycle", **rec)
+    ok = bool(cycles) and all(c["recovered"] for c in cycles)
+    report = {
+        "cycles": len(cycles), "ok": ok,
+        "faults": [c["fault"] for c in cycles],
+        "recovered": sum(c["recovered"] for c in cycles),
+        "monitor_exits": [c["monitor_exit"] for c in cycles],
+        "recovery_wall_s": [c["wall_s"] for c in cycles],
+        "world": args.world, "smoke": bool(args.smoke),
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "per_cycle": cycles,
+    }
+    driver_log.log("soak_report", **report)
+    driver_log.close()
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--data-dir", default="/tmp/imagenet_soak_data")
@@ -107,7 +326,49 @@ def main() -> None:
                              "(0 = the whole train split)")
     parser.add_argument("--score-method", default="el2n")
     parser.add_argument("--half-precision", action="store_true")
+    # --- soak mode ---
+    parser.add_argument("--soak", action="store_true",
+                        help="fault-injecting elastic soak instead of the "
+                             "ingestion benchmark")
+    parser.add_argument("--smoke", action="store_true",
+                        help="bounded CPU soak (<=60 s, tiny synthetic "
+                             "train workload; tier-1's lane) — implies "
+                             "--soak")
+    parser.add_argument("--workdir", default="/tmp/ddt_soak",
+                        help="soak working directory (one subdir per cycle)")
+    parser.add_argument("--command", default=None,
+                        help="CLI command each cycle drives (default: "
+                             "train in smoke, run otherwise)")
+    parser.add_argument("--schedule", default=None,
+                        help=f"comma-separated fault cycle schedule from "
+                             f"{sorted(FAULTS)} (default smoke: "
+                             f"{SMOKE_SCHEDULE}; soak: {SOAK_SCHEDULE})")
+    parser.add_argument("--cycles", type=int, default=None,
+                        help="total cycles (schedule repeats); default: one "
+                             "pass over the schedule")
+    parser.add_argument("--duration", type=float, default=None,
+                        help="stop starting new cycles after this many "
+                             "seconds (the long-haul bound)")
+    parser.add_argument("--world", type=int, default=None,
+                        help="worker processes per cycle (default: 1 smoke, "
+                             "2 soak)")
+    parser.add_argument("--epochs", type=int, default=3,
+                        help="soak-cycle retrain epochs (non-smoke)")
+    parser.add_argument("--step-timeout", type=float, default=None,
+                        help="resilience.step_timeout_s for soak children "
+                             "(default 20 smoke / 120 soak)")
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args()
+
+    if args.soak or args.smoke:
+        if args.world is None:
+            args.world = 1 if args.smoke else 2
+        if args.step_timeout is None:
+            args.step_timeout = 20.0 if args.smoke else 120.0
+        if args.command is None:
+            args.command = "train" if args.smoke else "run"
+        raise SystemExit(soak_main(args))
 
     have = all(os.path.exists(os.path.join(args.data_dir, f))
                for f in ("train_images.npy", "train_labels.npy",

@@ -109,7 +109,8 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
             out["fleet"] = view   # stream's fleet_status fallback below
     if metrics:
         recs = tail_records(metrics, ("epoch", "run_summary", "slo_violation",
-                                      "fleet_status", "summary"))
+                                      "fleet_status", "summary",
+                                      "elastic_event", "soak_report"))
         ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
         if ts:
             # Liveness of the STREAM itself: a run with no terminal record
@@ -123,6 +124,23 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
         terminal = [r for r in recs if r.get("kind") == "run_summary"]
         if terminal:
             out["run_summary"] = terminal[-1]
+        elastic = [r for r in recs if r.get("kind") == "elastic_event"]
+        if elastic:
+            # Display-only: recoveries never flip the verdict (a shrunken
+            # pod that finished healthy IS healthy — that's the point).
+            out["elastic"] = {
+                "events": len(elastic),
+                "shrinks": sum(r.get("event") == "shrink" for r in elastic),
+                "grows": sum(r.get("event") == "grow" for r in elastic),
+                "restarts": sum(r.get("event") == "restart" for r in elastic),
+                "last": elastic[-1].get("event"),
+                "world": elastic[-1].get("world"),
+            }
+        soak = [r for r in recs if r.get("kind") == "soak_report"]
+        if soak:
+            out["soak_report"] = {k: soak[-1].get(k)
+                                  for k in ("cycles", "ok", "faults",
+                                            "recovered")}
         fleet_recs = [r for r in recs if r.get("kind") == "fleet_status"]
         if fleet_recs and out.get("fleet") is None:
             # A recorded snapshot's ages are as-of-WRITE: project them to
@@ -231,6 +249,18 @@ def render(info: dict) -> str:
                      f"{_fmt(fleet.get('stalest_age_s'))}s"
                      + (f"  STRAGGLER {fleet.get('straggler_reason')}"
                         if fleet.get("straggler_rank") is not None else ""))
+    el = info.get("elastic")
+    if el:
+        lines.append(f"elastic: {el['events']} event(s) — "
+                     f"{el['shrinks']} shrink / {el['grows']} grow / "
+                     f"{el['restarts']} restart; last={el['last']} "
+                     f"world={el['world']}")
+    soak = info.get("soak_report")
+    if soak:
+        verdict = "ok" if soak.get("ok") else "NOT ok"
+        lines.append(f"soak: {soak.get('recovered')}/{soak.get('cycles')} "
+                     f"cycle(s) recovered ({verdict}) "
+                     f"faults={soak.get('faults')}")
     viol = info.get("violations") or []
     lines.append(f"slo: {len(viol)} violation record(s)")
     for v in viol[-5:]:

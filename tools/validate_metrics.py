@@ -56,6 +56,10 @@ KNOWN_KINDS = frozenset({
     # per-step collective-byte estimates + overlap verdict, and per-save
     # checkpoint-tier transitions (local -> durable promotion, errors).
     "comm_stats", "ckpt_tier",
+    # Elastic pod (resilience/elastic.py + tools/imagenet_soak.py):
+    # supervisor decisions (launch/shrink/grow/restart/give_up, stage-
+    # boundary resize honors) and the soak driver's terminal verdict.
+    "elastic_event", "soak_report",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -98,6 +102,11 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "comm_stats": ("mesh", "bytes_per_step", "overlap_ratio",
                    "sharded_update"),
     "ckpt_tier": ("step", "tier"),
+    # Elastic pod. Null-tolerant like xla_program: a stage-boundary resize
+    # honor has no rcs, a give_up has no new_world — only the event name is
+    # universal; per-event payloads ride as optional fields.
+    "elastic_event": ("event",),
+    "soak_report": ("cycles", "ok"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
