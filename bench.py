@@ -90,6 +90,28 @@ DEFAULT_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 SLO_THRESHOLD = 0.10
 
 
+_RUN_ID: str | None = None
+
+
+def _run_id() -> str:
+    """Run lineage id on every BENCH line + ledger record, so a perf_sentry
+    group joins back to the full run's artifacts. Follows obs/lineage.py's
+    env convention WITHOUT importing the package: an early error line must
+    not pull jax into sys.modules — ``_append_ledger`` treats the module's
+    presence as backend evidence, and calling into a wedged backend on the
+    error path is the exact hang the bench is hardened against. Exported to
+    env so a ``--fresh-retries`` child emits the SAME id as the parent's
+    probe failures."""
+    global _RUN_ID
+    if _RUN_ID is None:
+        import uuid
+        _RUN_ID = os.environ.get("DDT_RUN_ID") or (
+            time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+            + "-" + uuid.uuid4().hex[:6])
+        os.environ.setdefault("DDT_RUN_ID", _RUN_ID)
+    return _RUN_ID
+
+
 def _slo_verdict(metric: str, value: float, unit: str) -> dict | None:
     """Final SLO verdict for a MEASURED line: this value vs the trailing
     median of clean ledger records of the same (metric, geometry) shape —
@@ -126,6 +148,7 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, **extra) -> N
             "vs_baseline": vs_baseline}
     line.update(_CAPTURE_DIAGNOSTICS)
     line.update(extra)
+    line.setdefault("run_id", _run_id())
     if "error" not in line and value > 0:
         slo = _slo_verdict(metric, value, unit)
         if slo is not None:
@@ -152,7 +175,7 @@ def _append_ledger(line: dict) -> None:
                "source": "bench", "geometry": _LEDGER["geometry"]}
         for k in ("metric", "value", "unit", "vs_baseline", "error",
                   "exit_class", "chunk_steps", "mfu", "pass_s",
-                  "score_stability", "slo", "serve", "comm"):
+                  "score_stability", "slo", "serve", "comm", "run_id"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init

@@ -68,8 +68,10 @@ def main(argv: list[str] | None = None) -> int:
         logger.log("run_summary",
                    wall_s=round(time.perf_counter() - mono0, 3),
                    exit_class=supervisor.exit_class(rc), command=command,
-                   elastic={"attempts": supervisor.attempt + 1,
-                            "final_world": supervisor.world})
+                   # Whole-lineage verdict (attempts, worlds, recoveries,
+                   # supervision gap): the supervisor's terminal record is
+                   # the one line that judges the RUN, not its last attempt.
+                   lineage=supervisor.lineage_block())
         logger.close()
         return rc
     from .resilience import inject
@@ -261,10 +263,15 @@ def _append_perf_ledger(cfg: Config, command: str, summary: dict) -> None:
     try:
         import time as _time
 
+        from .obs import lineage as obs_lineage
         from .utils.io import atomic_append_jsonl
         final = summary.get("final") or {}
+        lin = obs_lineage.ensure()
         rec = {
             "kind": "perf_history", "ts": round(_time.time(), 3),
+            # Joinable back to the full run: the same run_id/attempt every
+            # record of this run's metrics stream carries.
+            "run_id": lin.run_id, "attempt": lin.attempt,
             "source": "cli", "metric": f"cli_{command}_wall_s",
             "value": summary.get("wall_s"), "unit": "seconds",
             "exit_class": summary.get("exit_class"),

@@ -477,6 +477,13 @@ class ObsConfig:
     slo_nonfinite_frac: float | None = None
     # Eval-accuracy floor checked at each eval boundary.
     slo_eval_accuracy_floor: float | None = None
+    # Cross-attempt recovery budget (seconds): time from the supervisor's
+    # fault classification to the FIRST post-resume training step of the
+    # relaunched attempt, computed from the lineage-stamped records in the
+    # shared metrics stream (obs/lineage.py). Checked once per resumed
+    # attempt; tools/postmortem.py applies the same budget offline via
+    # --recovery-budget-s. None = no recovery SLO.
+    slo_recovery_s: float | None = None
 
 
 @dataclass
@@ -666,6 +673,9 @@ class Config:
             raise ValueError(
                 f"obs.slo_eval_accuracy_floor must be in [0, 1], got "
                 f"{o.slo_eval_accuracy_floor}")
+        if o.slo_recovery_s is not None and o.slo_recovery_s <= 0:
+            raise ValueError(
+                f"obs.slo_recovery_s must be > 0, got {o.slo_recovery_s}")
         return self
 
 

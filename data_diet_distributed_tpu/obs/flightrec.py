@@ -27,12 +27,42 @@ import time
 
 import numpy as np
 
-__all__ = ["FlightRecorder", "flightrec_path", "install", "uninstall",
-           "current", "record", "dump"]
+__all__ = ["FlightRecorder", "flightrec_path", "read_dumps", "install",
+           "uninstall", "current", "record", "dump"]
 
 
-def flightrec_path(directory: str, rank: int) -> str:
-    return os.path.join(directory, f"flightrec_rank{rank}.json")
+def flightrec_path(directory: str, rank: int, attempt: int = 0) -> str:
+    """Attempt 0 keeps the historical name; later attempts are suffixed
+    (``flightrec_rank<k>_a<attempt>.json``) so an elastic relaunch never
+    overwrites the crashed attempt's final moments — the dump IS the
+    evidence of the failure the relaunch is recovering from."""
+    from . import lineage
+    return os.path.join(
+        directory, f"flightrec_rank{rank}{lineage.attempt_suffix(attempt)}.json")
+
+
+def read_dumps(directory: str) -> list[dict]:
+    """Every flight-recorder dump in ``directory``, across ranks AND
+    attempts, unreadable files skipped — the postmortem's reader. Each
+    payload carries its own ``rank``/``attempt``/``reason``/``events``."""
+    from . import lineage
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("flightrec_rank") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(payload, dict):
+            payload.setdefault("attempt", lineage.attempt_of(name))
+            out.append(payload)
+    return out
 
 
 def json_safe(v):
@@ -58,9 +88,10 @@ def json_safe(v):
 
 class FlightRecorder:
     def __init__(self, directory: str = ".", rank: int = 0,
-                 capacity: int = 256):
+                 capacity: int = 256, attempt: int = 0):
         self.directory = os.path.abspath(directory)
         self.rank = rank
+        self.attempt = int(attempt)
         self.capacity = int(capacity)
         self._ring: collections.deque = collections.deque(maxlen=self.capacity)
         # RLock, not Lock: record() is called from signal handlers (the
@@ -87,10 +118,14 @@ class FlightRecorder:
         previous dump — latest final moments win). Returns the path, or None
         when the write itself failed (a dying disk must not mask the original
         fault with its own exception)."""
-        path = flightrec_path(self.directory, self.rank)
+        from . import lineage
+        path = flightrec_path(self.directory, self.rank, self.attempt)
         try:
             os.makedirs(self.directory, exist_ok=True)
-            payload = {"rank": self.rank, "reason": str(reason)[:500],
+            lin = lineage.current()
+            payload = {"rank": self.rank, "attempt": self.attempt,
+                       "run_id": lin.run_id if lin is not None else None,
+                       "reason": str(reason)[:500],
                        "dumped_ts": round(time.time(), 3), "pid": os.getpid(),
                        "capacity": self.capacity, "events": self.snapshot()}
             tmp = f"{path}.tmp"

@@ -26,10 +26,14 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import socket
 import time
 
+from . import lineage
+
 __all__ = ["Heartbeat", "default_dir", "read_heartbeats", "describe_stale",
+           "archive_heartbeat", "read_heartbeat_residue",
            "install", "uninstall", "current", "beat", "describe"]
 
 
@@ -76,6 +80,13 @@ class Heartbeat:
                 self._made_dir = True
             payload = {"rank": self.rank, "ts": round(time.time(), 3),
                        "pid": os.getpid(), "host": socket.gethostname()}
+            # Lineage context: which attempt (and run) this rank's last
+            # progress belongs to — the supervisor archives these files on
+            # host loss, and the postmortem must attribute the residue.
+            lin = lineage.current()
+            if lin is not None:
+                payload["attempt"] = lin.attempt
+                payload["run_id"] = lin.run_id
             if step is not None:
                 payload["step"] = int(step)
             if epoch is not None:
@@ -111,6 +122,50 @@ def read_heartbeats(directory: str) -> dict[int, dict]:
             out[int(rec["rank"])] = rec
         except (OSError, ValueError, KeyError):
             continue
+    return out
+
+
+def residue_path(directory: str, rank: int, attempt: int) -> str:
+    """Archive name for a departed rank's heartbeat: the ``.a<attempt>``
+    suffix goes AFTER ``.json`` so ``read_heartbeats``'s live-file filter
+    (endswith ``.json``) can never resurrect a ghost rank from it."""
+    return f"{heartbeat_path(directory, rank)}.a{int(attempt)}"
+
+
+def archive_heartbeat(directory: str, rank: int, attempt: int) -> bool:
+    """Move a rank's heartbeat aside instead of deleting it (the elastic
+    supervisor's shrink path): the file is the dead rank's last recorded
+    progress — exactly the evidence a postmortem needs — while the live
+    view must stop reporting the ghost. Returns whether a file moved."""
+    try:
+        os.replace(heartbeat_path(directory, rank),
+                   residue_path(directory, rank, attempt))
+        return True
+    except OSError:
+        return False
+
+
+def read_heartbeat_residue(directory: str) -> list[dict]:
+    """Archived heartbeats (``heartbeat_rank<k>.json.a<attempt>``), each
+    with ``rank``/``attempt`` attached — the postmortem's view of where
+    every departed rank stopped, per attempt it departed in."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        m = re.match(r"heartbeat_rank(\d+)\.json\.a(\d+)$", name)
+        if m is None:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec["rank"] = int(m.group(1))
+        rec["attempt"] = int(m.group(2))
+        out.append(rec)
     return out
 
 

@@ -1,0 +1,157 @@
+"""Run lineage: one stable ``run_id`` across every attempt of an elastic run.
+
+PR 11 made runs elastic — one LOGICAL run now spans multiple attempts (the
+supervisor relaunches after host loss/join), multiple world sizes, and a
+checkpoint lineage that crosses them. Every observability artifact was still
+per-attempt: the metrics JSONL mixes records from every attempt with nothing
+naming which attempt wrote them, and relaunches clobbered the crashed
+attempt's flight-recorder dumps and traces. This module is the identity
+layer that makes "what happened to this run" answerable:
+
+* **run_id** — one stable identifier for the whole supervised run, assigned
+  by the ``ElasticSupervisor`` (or generated at first use in a plain
+  single-process run) and threaded to children via ``DDT_RUN_ID``.
+* **attempt** — monotonically assigned by the supervisor per relaunch
+  (``DDT_ELASTIC_ATTEMPT``, which the supervisor already sets); a
+  single-process run is attempt 0.
+* **world** — the worker count the attempt was launched at
+  (``DDT_ELASTIC_WORLD``); absent outside supervision.
+
+``stamp()`` writes these as ambient context into every JSONL record both
+logger types emit (``obs.MetricsLogger`` and the supervisor's jax-free
+``JsonlLogger``) — never overwriting a field the caller set explicitly —
+and ``attempt_suffix``/``suffixed_path`` name the per-attempt artifact
+files (flight-recorder dumps, traces) so a recovery never destroys the
+evidence of the failure that caused it.
+
+Deliberately jax-free: the supervisor stamps through this module while its
+children claim and release backends.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+import uuid
+from dataclasses import dataclass
+
+__all__ = ["Lineage", "RUN_ID_ENV", "ATTEMPT_ENV", "WORLD_ENV",
+           "new_run_id", "from_env", "child_env", "install", "uninstall",
+           "current", "ensure", "stamp", "attempt_suffix", "suffixed_path"]
+
+RUN_ID_ENV = "DDT_RUN_ID"
+#: Shared with resilience/elastic.py, which has set this per-child since
+#: PR 11 — lineage reads the attempt the supervisor already assigns.
+ATTEMPT_ENV = "DDT_ELASTIC_ATTEMPT"
+WORLD_ENV = "DDT_ELASTIC_WORLD"
+
+
+@dataclass
+class Lineage:
+    run_id: str
+    attempt: int = 0
+    world: int | None = None
+
+
+def new_run_id() -> str:
+    """Sortable-by-start-time and collision-safe: UTC stamp + random hex.
+    Short enough to ride every JSONL record without dominating it."""
+    return (time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+            + "-" + uuid.uuid4().hex[:6])
+
+
+def _int_env(env, key) -> int | None:
+    raw = env.get(key)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def from_env(environ=None) -> Lineage:
+    """The lineage a supervisor threaded into this process — or a fresh
+    attempt-0 identity when none did (plain single-process runs)."""
+    env = os.environ if environ is None else environ
+    return Lineage(run_id=env.get(RUN_ID_ENV) or new_run_id(),
+                   attempt=_int_env(env, ATTEMPT_ENV) or 0,
+                   world=_int_env(env, WORLD_ENV))
+
+
+def child_env(run_id: str, attempt: int, world: int) -> dict[str, str]:
+    """The env block a supervisor sets on every spawned worker."""
+    return {RUN_ID_ENV: str(run_id), ATTEMPT_ENV: str(int(attempt)),
+            WORLD_ENV: str(int(world))}
+
+
+# --------------------------------------------------------- module-level slot
+
+_LINEAGE: Lineage | None = None
+
+
+def install(lin: Lineage) -> Lineage:
+    global _LINEAGE
+    _LINEAGE = lin
+    return lin
+
+
+def uninstall() -> None:
+    global _LINEAGE
+    _LINEAGE = None
+
+
+def current() -> Lineage | None:
+    return _LINEAGE
+
+
+def ensure() -> Lineage:
+    """The process's lineage, resolved ONCE: env (supervisor-assigned) wins,
+    else a fresh attempt-0 identity is generated and installed — so every
+    record a process writes carries the same run_id."""
+    global _LINEAGE
+    if _LINEAGE is None:
+        _LINEAGE = from_env()
+    return _LINEAGE
+
+
+def stamp(record: dict) -> dict:
+    """Ambient lineage into one JSONL record, in place. Never overwrites a
+    field the emitter set explicitly (the supervisor's elastic_event records
+    carry their own ``attempt``/``world`` — those ARE the authority)."""
+    lin = ensure()
+    record.setdefault("run_id", lin.run_id)
+    record.setdefault("attempt", lin.attempt)
+    if lin.world is not None:
+        record.setdefault("world", lin.world)
+    return record
+
+
+# -------------------------------------------------- per-attempt artifact names
+
+def attempt_suffix(attempt: int | None) -> str:
+    """``""`` for attempt 0 (the historical single-attempt names stay
+    byte-identical), ``"_a<k>"`` after — so a relaunch writes NEXT TO the
+    crashed attempt's artifacts instead of over them."""
+    return "" if not attempt else f"_a{int(attempt)}"
+
+
+def suffixed_path(path: str, attempt: int | None) -> str:
+    """Insert the attempt suffix before the extension:
+    ``trace.json`` -> ``trace_a2.json``."""
+    suffix = attempt_suffix(attempt)
+    if not suffix:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}{suffix}{ext}"
+
+
+_ATTEMPT_RE = re.compile(r"_a(\d+)(?=[_.]|$)")
+
+
+def attempt_of(filename: str) -> int:
+    """The attempt encoded in an artifact filename (0 when unsuffixed) —
+    the reverse of ``attempt_suffix``, for the postmortem's readers."""
+    m = _ATTEMPT_RE.search(os.path.basename(filename))
+    return int(m.group(1)) if m else 0

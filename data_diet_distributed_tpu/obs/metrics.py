@@ -25,7 +25,7 @@ from typing import Any, IO
 
 import jax
 
-from . import flightrec
+from . import flightrec, lineage
 
 
 def _json_default(v: Any):
@@ -43,6 +43,7 @@ def _json_default(v: Any):
 class MetricsLogger:
     def __init__(self, path: str | None, echo: bool = True):
         self.echo = echo
+        self.path = path   # readers (the recovery-SLO anchor) need the stream
         self._fh: IO[str] | None = None
         if path and jax.process_index() == 0:
             parent = os.path.dirname(path)
@@ -56,7 +57,14 @@ class MetricsLogger:
         flightrec.record(kind, **fields)
         if jax.process_index() != 0:
             return
-        record = {"ts": round(time.time(), 3), "kind": kind, **fields}
+        # Ambient lineage (run_id / attempt / world) on EVERY record — the
+        # stream of an elastic run holds every attempt's records, and the
+        # postmortem layer needs to know which attempt wrote each one.
+        # setdefault semantics: an explicit field (elastic_event's attempt,
+        # the resume record's world) is never overwritten. Echo keeps the
+        # caller's fields only — lineage is stream context, not log noise.
+        record = lineage.stamp({"ts": round(time.time(), 3), "kind": kind,
+                                **fields})
         if self._fh is not None:
             self._fh.write(json.dumps(record, default=_json_default) + "\n")
         if self.echo:

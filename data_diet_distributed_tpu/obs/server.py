@@ -352,6 +352,13 @@ class StatusServer:
             snap = reg.snapshot()
             gauges, hists = snap["gauges"], snap["histograms"]
         out: dict = {"ts": round(time.time(), 3)}
+        from . import lineage as obs_lineage
+        lin = obs_lineage.current()
+        if lin is not None:
+            # Which (run, attempt) is answering: a monitor polling across an
+            # elastic relaunch can tell the new incarnation from the old.
+            out["lineage"] = {"run_id": lin.run_id, "attempt": lin.attempt,
+                              "world": lin.world}
         for k in ("stage", "epoch", "step", "total_epochs", "steps_per_epoch",
                   "chunk_steps", "epochs_done", "dispatches_done",
                   "dispatches_per_epoch", "epoch_s"):

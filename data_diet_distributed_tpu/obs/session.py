@@ -22,8 +22,8 @@ from __future__ import annotations
 
 import os
 
-from . import (fleet, flightrec, heartbeat, registry, scoreboard, server,
-               slo, tracing, xla)
+from . import (fleet, flightrec, heartbeat, lineage, registry, scoreboard,
+               server, slo, tracing, xla)
 from .profiler import ProfileWindow
 
 DEFAULT_TRACE_NAME = "trace.json"
@@ -58,11 +58,17 @@ class ObsSession:
         import jax
         cfg = self.cfg
         rank = jax.process_index()
+        # Run lineage: supervisor-assigned (env) or a fresh attempt-0
+        # identity. Resolved before any artifact path so per-attempt
+        # suffixes (traces, flight-recorder dumps) are consistent.
+        lin = lineage.ensure()
         if cfg.obs.trace:
             base = cfg.obs.trace_path or os.path.join(_workdir(cfg),
                                                       DEFAULT_TRACE_NAME)
             self.tracer = tracing.install(
-                tracing.Tracer(tracing.trace_path_for(base, rank), rank=rank))
+                tracing.Tracer(tracing.trace_path_for(base, rank,
+                                                      lin.attempt),
+                               rank=rank))
         # Prometheus textfile is rank-0 only (like the JSONL): N ranks
         # overwriting one shared file would flap the scraped values.
         self.registry = registry.install(registry.MetricsRegistry(
@@ -74,7 +80,8 @@ class ObsSession:
         if cfg.obs.flightrec:
             fr_dir = cfg.obs.flightrec_dir or _workdir(cfg)
             self.recorder = flightrec.install(flightrec.FlightRecorder(
-                fr_dir, rank, capacity=cfg.obs.flightrec_capacity))
+                fr_dir, rank, capacity=cfg.obs.flightrec_capacity,
+                attempt=lin.attempt))
         if cfg.obs.xla_introspect:
             self.xla = xla.install(
                 xla.XlaIntrospector(logger=self.logger),

@@ -19,6 +19,13 @@ and evaluated DURING the run at the points where their inputs exist —
   stalest rank's heartbeat age at epoch boundaries (the live /healthz
   verdict uses the same budget continuously; the boundary check is what
   leaves a durable record when a straggler recovers between polls).
+* **recovery budget** — ``slo_recovery_s``, the one CROSS-ATTEMPT
+  objective: on an elastic relaunch (lineage attempt > 0), the wall from
+  the supervisor's fault classification — read from the lineage-stamped
+  records the previous attempt left in the shared stream — to this
+  attempt's first post-resume training step. One verdict per resumed
+  attempt; ``tools/postmortem.py --recovery-budget-s`` applies the same
+  budget offline.
 
 Each violation emits ONE ``{"kind": "slo_violation"}`` JSONL record (the
 MetricsLogger mirrors every event into the fault flight recorder before its
@@ -111,11 +118,19 @@ class SloEngine:
                  heartbeat_stale_s: float | None = None,
                  nonfinite_frac: float | None = None,
                  eval_accuracy_floor: float | None = None,
+                 recovery_s: float | None = None,
                  baseline_window: int = DEFAULT_BASELINE_WINDOW,
                  geometry: dict | None = None, logger=None):
         self.throughput_floor = throughput_floor
         self.throughput_frac = throughput_frac
         self.ledger = ledger
+        self.recovery_s = recovery_s
+        # Cross-attempt recovery check state: the fault-classification ts
+        # (read from the lineage-stamped stream at resume time) and the
+        # one-shot latch — one recovery verdict per relaunched attempt.
+        self._recovery_anchor: float | None = None
+        self._recovery_attempt = 0
+        self._recovery_done = False
         # The ledger-baseline grouping key (the sentry's discipline: never
         # compare against runs of a different shape). None = unfiltered —
         # only for callers whose ledger holds one shape by construction.
@@ -140,7 +155,7 @@ class SloEngine:
         o = cfg.obs
         if not any((o.slo_throughput_floor, o.slo_throughput_frac,
                     o.slo_heartbeat_stale_s, o.slo_nonfinite_frac,
-                    o.slo_eval_accuracy_floor)):
+                    o.slo_eval_accuracy_floor, o.slo_recovery_s)):
             return None
         # The SAME geometry block cli._append_perf_ledger writes: the
         # baseline this run is held to is the trail of runs of its own shape.
@@ -154,6 +169,7 @@ class SloEngine:
                    heartbeat_stale_s=o.slo_heartbeat_stale_s,
                    nonfinite_frac=o.slo_nonfinite_frac,
                    eval_accuracy_floor=o.slo_eval_accuracy_floor,
+                   recovery_s=o.slo_recovery_s,
                    logger=logger)
 
     # ----------------------------------------------------------- plumbing
@@ -163,7 +179,7 @@ class SloEngine:
         examples) — resolved throughput floor included once known."""
         out = {k: getattr(self, k) for k in
                ("throughput_floor", "throughput_frac", "heartbeat_stale_s",
-                "nonfinite_frac", "eval_accuracy_floor")
+                "nonfinite_frac", "eval_accuracy_floor", "recovery_s")
                if getattr(self, k) is not None}
         if self._baseline_resolved:
             out["throughput_baseline"] = self._baseline
@@ -268,6 +284,78 @@ class SloEngine:
                       rank=view["straggler_rank"],
                       reason=view["straggler_reason"])
 
+    def arm_recovery(self, metrics_path: str | None) -> bool:
+        """Arm the cross-attempt recovery check at resume time (attempt > 0
+        only): read the shared lineage-stamped stream for the supervisor's
+        fault classification of the previous attempt (``children_exited``;
+        degrading to the last fault-class record) and anchor the recovery
+        clock there — the budget covers relaunch + restore + compile, not
+        just this process's own startup. An operator-requested grow/resize
+        relaunch never arms: it is not a failure recovery, and the offline
+        judges (postmortem, lineage_block) exclude it the same way.
+        Returns whether armed."""
+        if self.recovery_s is None or self._recovery_done \
+                or self._recovery_anchor is not None:
+            return self._recovery_anchor is not None
+        from . import lineage
+        lin = lineage.current() or lineage.ensure()
+        if lin.attempt == 0 or not metrics_path:
+            return False
+        from .timeline import read_records
+        classified = fault_ts = None
+        requested = False
+        for rec in read_records(metrics_path):
+            if not isinstance(rec.get("ts"), (int, float)):
+                continue
+            att = rec.get("attempt")
+            if not isinstance(att, int) or att >= lin.attempt:
+                continue
+            if rec.get("kind") == "elastic_event":
+                if rec.get("event") == "children_exited":
+                    classified = rec["ts"]
+                    requested = False
+                elif rec.get("event") in ("shrink", "grow", "resize",
+                                          "restart"):
+                    # The decision that follows the classification; only
+                    # the LAST pair (the transition into this attempt)
+                    # stands at the end of the scan.
+                    requested = rec["event"] in ("grow", "resize")
+            elif rec.get("kind") in ("fault", "preempted"):
+                fault_ts = rec["ts"]   # last fault-class record wins
+        if classified is not None and requested:
+            return False
+        anchor = classified if classified is not None else fault_ts
+        if anchor is None:
+            return False
+        self._recovery_anchor = anchor
+        self._recovery_attempt = lin.attempt
+        return True
+
+    def note_training_step(self, *, logger=None,
+                           now: float | None = None) -> None:
+        """The recovery clock's far end: the first training step this
+        process dispatches after an armed resume. One verdict per attempt —
+        records the measured wall as a gauge, and a violation only when it
+        blows the budget (recovering at all is the healthy outcome)."""
+        if self._recovery_anchor is None or self._recovery_done:
+            return
+        self._recovery_done = True
+        import time as _time
+        wall = (now if now is not None else _time.time()) \
+            - self._recovery_anchor
+        # Disarm: the module-level hook gates on _recovery_anchor, so
+        # clearing it restores the one-attribute-check fast path for every
+        # training step after the single verdict.
+        self._recovery_anchor = None
+        from . import registry as obs_registry
+        obs_registry.set_gauge("slo_recovery_wall_s", round(wall, 3))
+        if self.recovery_s is not None and wall > self.recovery_s:
+            self._violate("recovery", round(wall, 3), self.recovery_s,
+                          logger=logger,
+                          point=("recovery", self._recovery_attempt),
+                          attempt=self._recovery_attempt)
+        self._mark_ok()
+
     def check_scores(self, method: str, scores, *, logger=None) -> None:
         """Scoring-pass evaluation: the nonfinite-score budget over the
         final score vector (a scoring pass whose output is part-NaN is a
@@ -315,3 +403,16 @@ def check_epoch(**kwargs) -> None:
 def check_scores(method: str, scores, *, logger=None) -> None:
     if _ENGINE is not None:
         _ENGINE.check_scores(method, scores, logger=logger)
+
+
+def arm_recovery(metrics_path: str | None) -> None:
+    """Library-code entry (fit's resume path): no-op until installed."""
+    if _ENGINE is not None:
+        _ENGINE.arm_recovery(metrics_path)
+
+
+def note_training_step(*, logger=None) -> None:
+    """First-dispatch hook in the train loops: one attribute check when
+    the recovery clock is not armed (the common case)."""
+    if _ENGINE is not None and _ENGINE._recovery_anchor is not None:
+        _ENGINE.note_training_step(logger=logger)

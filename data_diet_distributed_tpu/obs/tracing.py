@@ -28,25 +28,66 @@ unconditionally at zero cost to un-instrumented callers (tests, bench loops).
 from __future__ import annotations
 
 import contextlib
+import glob
 import json
 import os
+import re
 import socket
 import threading
 import time
 from typing import IO
 
 __all__ = ["Tracer", "span", "instant", "install", "uninstall", "current",
-           "trace_path_for"]
+           "trace_path_for", "discover_traces", "trace_coords"]
 
 
-def trace_path_for(base: str, rank: int) -> str:
-    """Per-rank trace file path: rank 0 keeps ``base`` (the common
-    single-process case stays ``trace.json``); other ranks get a
-    ``_rank<k>`` suffix so multi-host runs never clobber each other."""
-    if rank == 0:
+def trace_path_for(base: str, rank: int, attempt: int = 0) -> str:
+    """Per-(attempt, rank) trace file path: attempt 0 / rank 0 keeps
+    ``base`` (the common single-process case stays ``trace.json``); other
+    coordinates get ``_a<attempt>`` / ``_rank<k>`` suffixes so neither
+    multi-host ranks nor elastic relaunches ever clobber each other —
+    the crashed attempt's trace is postmortem evidence."""
+    from . import lineage
+    suffix = lineage.attempt_suffix(attempt)
+    if rank != 0:
+        suffix += f"_rank{rank}"
+    if not suffix:
         return base
     root, ext = os.path.splitext(base)
-    return f"{root}_rank{rank}{ext or '.json'}"
+    return f"{root}{suffix}{ext or '.json'}"
+
+
+_COORD_RE = re.compile(r"^(?:_a(\d+))?(?:_rank(\d+))?$")
+
+
+def trace_coords(base: str, path: str) -> tuple[int, int] | None:
+    """``(attempt, rank)`` encoded in a trace filename relative to ``base``
+    (the reverse of ``trace_path_for``), or None when ``path`` is not one of
+    base's per-(attempt, rank) variants."""
+    root, ext = os.path.splitext(base)
+    stem = os.path.splitext(path)[0]
+    if not stem.startswith(root):
+        return None
+    m = _COORD_RE.match(stem[len(root):])
+    if m is None:
+        return None
+    return int(m.group(1) or 0), int(m.group(2) or 0)
+
+
+def discover_traces(base: str) -> list[dict]:
+    """Every existing per-(attempt, rank) trace sharing ``base``'s stem,
+    as ``{"path", "attempt", "rank"}`` rows sorted by (attempt, rank) —
+    how ``tools/trace_report.py`` and the postmortem merge a whole elastic
+    run's traces from just the configured base path."""
+    root, ext = os.path.splitext(base)
+    found = []
+    for path in sorted(glob.glob(f"{glob.escape(root)}*{ext or '.json'}")):
+        coords = trace_coords(base, path)
+        if coords is not None:
+            found.append({"path": path, "attempt": coords[0],
+                          "rank": coords[1]})
+    found.sort(key=lambda r: (r["attempt"], r["rank"]))
+    return found
 
 
 class Tracer:

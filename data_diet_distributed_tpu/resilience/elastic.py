@@ -51,6 +51,8 @@ import subprocess
 import sys
 import time
 
+from ..obs import lineage
+
 #: Exit statuses the supervisor classifies (mirrors of the CLI contract;
 #: kept literal here so the supervisor stays jax-free).
 EXIT_PREEMPTED = 75
@@ -182,7 +184,11 @@ class JsonlLogger:
             self._fh = open(path, "a", buffering=1)
 
     def log(self, kind: str, **fields) -> None:
-        record = {"ts": round(time.time(), 3), "kind": kind, **fields}
+        # Same ambient-lineage stamp as MetricsLogger (lineage is jax-free):
+        # the supervisor's records land in the same stream as its workers',
+        # and the postmortem must attribute every line to a run + attempt.
+        record = lineage.stamp({"ts": round(time.time(), 3), "kind": kind,
+                                **fields})
         if self._fh is not None:
             try:
                 self._fh.write(json.dumps(record) + "\n")
@@ -252,21 +258,24 @@ def survivors(heartbeat_dir: str | None, world: int,
 
 
 def clear_rank_artifacts(checkpoint_dir: str, heartbeat_dir: str | None,
-                         ranks: list[int]) -> None:
-    """Drop a departed rank's control-plane residue (heartbeat file, poison
-    record) so the shrunken pod's fleet view and the next consensus open
-    don't keep reporting a ghost. Checkpoint SHARDS are kept — the departed
-    rank's promoted tier files are exactly what the survivors restore."""
-    from ..obs.heartbeat import heartbeat_path
+                         ranks: list[int], attempt: int = 0) -> None:
+    """ARCHIVE a departed rank's control-plane residue (heartbeat file,
+    poison record) so the shrunken pod's fleet view and the next consensus
+    open don't keep reporting a ghost — while the postmortem keeps the
+    evidence: the files are renamed with an ``.a<attempt>`` suffix (which
+    no live reader matches), never deleted. Deleting them was PR 11's
+    behavior, and it destroyed the dead rank's last recorded progress in
+    the very act of recovering from its death. Checkpoint SHARDS are kept —
+    the departed rank's promoted tier files are exactly what the survivors
+    restore."""
+    from ..obs.heartbeat import archive_heartbeat
     for rank in ranks:
         if heartbeat_dir:
-            try:
-                os.remove(heartbeat_path(heartbeat_dir, rank))
-            except OSError:
-                pass
+            archive_heartbeat(heartbeat_dir, rank, attempt)
+        poison = os.path.join(f"{checkpoint_dir}_sidechannel",
+                              f"poison.rank{rank}.json")
         try:
-            os.remove(os.path.join(f"{checkpoint_dir}_sidechannel",
-                                   f"poison.rank{rank}.json"))
+            os.replace(poison, f"{poison}.a{int(attempt)}")
         except OSError:
             pass
 
@@ -322,6 +331,22 @@ class ElasticSupervisor:
         self.attempt = 0
         self._reaped: set[int] = set()
         self.events: list[dict] = []
+        # Run lineage: ONE run_id for the whole supervised run, threaded to
+        # every child attempt via env (an outer orchestrator's DDT_RUN_ID is
+        # honored; otherwise minted here). Installed so the supervisor's own
+        # JsonlLogger records carry it too.
+        self.run_id = os.environ.get(lineage.RUN_ID_ENV) or lineage.new_run_id()
+        # world stays None in the supervisor's OWN ambient stamp: its world
+        # changes across relaunches and every elastic_event already carries
+        # it explicitly — a stale ambient world would misstamp later records.
+        # attempt is kept in step by _next_attempt(): the supervisor's late
+        # records (terminal run_summary, perf ledger) must name the attempt
+        # the run actually ended on, not a pin at 0.
+        self._lineage = lineage.install(
+            lineage.Lineage(run_id=self.run_id, attempt=0))
+        self.worlds: list[int] = []       # world size of each launched attempt
+        self._lost_wall_s = 0.0           # classification -> relaunch gaps
+        self._classified_mono: float | None = None
         ckpt = cfg.train.checkpoint_dir
         self.checkpoint_dir = ckpt
         from ..obs.heartbeat import dir_from_cfg
@@ -329,6 +354,10 @@ class ElasticSupervisor:
         self.log_dir = elastic_dir(ckpt)
 
     # ------------------------------------------------------------- plumbing
+
+    def _next_attempt(self) -> None:
+        self.attempt += 1
+        self._lineage.attempt = self.attempt
 
     def _event(self, event: str, **fields) -> None:
         rec = {"event": event, "attempt": self.attempt,
@@ -360,7 +389,10 @@ class ElasticSupervisor:
                      coordinator: str):
         env = dict(os.environ)
         env[CHILD_ENV] = "1"
-        env["DDT_ELASTIC_ATTEMPT"] = str(attempt)
+        # Lineage identity: same run_id every attempt, attempt monotonic,
+        # world as launched — the children stamp all three into every JSONL
+        # record and suffix their per-attempt artifacts with the attempt.
+        env.update(lineage.child_env(self.run_id, attempt, world))
         if attempt > 0:
             # An env-armed fault plan (the README ops drills) fires once:
             # resume can replay the faulted unit, and an exact-coordinate
@@ -511,6 +543,14 @@ class ElasticSupervisor:
         while True:
             self._coordinator = f"127.0.0.1:{_free_port()}"
             world = self.world
+            if self._classified_mono is not None:
+                # Supervision gap: fault classification -> this relaunch.
+                # The full recovery wall (through restore + compile to the
+                # first training step) is the postmortem's record-derived
+                # number; this is the slice the supervisor itself owns.
+                self._lost_wall_s += time.monotonic() - self._classified_mono
+                self._classified_mono = None
+            self.worlds.append(world)
             self._event("launch", coordinator=(self._coordinator
                                                if world > 1 else None),
                         resume=self.attempt > 0)
@@ -519,6 +559,8 @@ class ElasticSupervisor:
             rcs = self._wait_attempt(procs)
             last_rcs = rcs
             action, info = self._classify(rcs)
+            if action != "done":
+                self._classified_mono = time.monotonic()
             self._event("children_exited", action=action, **info)
             if action == "done":
                 self._event("complete")
@@ -540,8 +582,12 @@ class ElasticSupervisor:
                     self._event("grow" if new_world > world else "resize",
                                 new_world=new_world)
                     self.world = new_world
-                    self.attempt += 1
-                    continue   # a requested resize is not a failure: no budget
+                    self._next_attempt()
+                    # A requested resize is not a failure: no budget, and
+                    # the gap to its relaunch is not LOST wall (same
+                    # exclusion the postmortem's lineage_view applies).
+                    self._classified_mono = None
+                    continue
                 if resize is not None:
                     # Malformed request (corrupt file, world=0): the stage
                     # barrier honored it, but it names no world to resize
@@ -571,7 +617,8 @@ class ElasticSupervisor:
                 dead = sorted(set(info["dead_ranks"]))
                 new_world = max(self.min_world, world - len(dead))
                 clear_rank_artifacts(self.checkpoint_dir, self.heartbeat_dir,
-                                     [r for r in range(new_world, world)])
+                                     [r for r in range(new_world, world)],
+                                     attempt=self.attempt)
                 self._event("shrink", dead_ranks=dead, new_world=new_world,
                             reaped_ranks=info["reaped_ranks"],
                             restarts_left=self.restarts_left)
@@ -581,9 +628,29 @@ class ElasticSupervisor:
             backoff = self.backoff_s * (2 ** min(self.attempt, 6))
             if backoff:
                 time.sleep(backoff)
-            self.attempt += 1
+            self._next_attempt()
 
     # ------------------------------------------------------------- terminal
+
+    def lineage_block(self) -> dict:
+        """The run's lineage summary for the supervisor's terminal
+        ``run_summary``: attempts launched, the world size of each, how many
+        relaunches were RECOVERIES (shrink/restart — a requested grow is not
+        a failure), and the wall the supervision gaps cost. The postmortem
+        derives the richer per-recovery chains from the records; this block
+        is the one-line answer a dashboard reads."""
+        recoveries = sum(e["event"] in ("shrink", "restart")
+                         for e in self.events)
+        # supervision_gap_s, NOT lost_wall_s: the supervisor owns only the
+        # classification -> relaunch slice. The full classification ->
+        # training-again wall needs the children's records and is the
+        # postmortem's lost_wall_s — one key per meaning, so a reader
+        # joining this record against a postmortem_report can never
+        # mistake the ~0.2 s gap for the ~4.5 s wall (or vice versa).
+        return {"run_id": self.run_id, "attempts": len(self.worlds) or 1,
+                "worlds": list(self.worlds),
+                "recoveries": recoveries,
+                "supervision_gap_s": round(self._lost_wall_s, 3)}
 
     def exit_class(self, rc: int) -> str:
         if rc == 0:

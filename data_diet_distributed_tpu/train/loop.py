@@ -358,6 +358,14 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
             ckpt.close()
         raise
 
+    # Cross-attempt recovery SLO (obs.slo_recovery_s): on a relaunched
+    # attempt (lineage attempt > 0 — checkpoint or not; a from-scratch
+    # relaunch is still a recovery), anchor the clock on the previous
+    # attempt's fault classification, read from the shared lineage-stamped
+    # stream; the first training dispatch below closes it. No-op when
+    # disabled or the engine is not installed.
+    obs_slo.arm_recovery(cfg.obs.metrics_path)
+
     result = FitResult(state=state)
     t_start = time.perf_counter()
     profile = None
@@ -607,6 +615,9 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                         obs_registry.timed("chunk_dispatch_s"):
                     state, metrics = _dispatch_chunk(chunk_fn, state,
                                                      train_resident, idx, mask)
+                # Recovery-SLO far end: the first dispatched training chunk
+                # after an armed resume (one attribute check when idle).
+                obs_slo.note_training_step(logger=logger)
                 step_metrics.append(metrics)
                 # HBM watermark poll at the chunk boundary (no-op on
                 # backends without memory_stats, e.g. CPU).
@@ -657,6 +668,8 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 state, metrics = train_step(state, batch)
                 obs_registry.observe("step_dispatch_s",
                                      time.perf_counter() - t_disp)
+                # Recovery-SLO far end (see the chunked branch).
+                obs_slo.note_training_step(logger=logger)
                 step_metrics.append(metrics)
                 # Streaming mode: bound dispatch runahead so queued
                 # host-uploaded batches can't pile up in HBM (resident batches
@@ -844,7 +857,7 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
             # train.resume=true — the checkpoints this run wrote make that
             # exact (SURVEY §5.3; PARITY.md 'Failure detection/recovery').
             logger.log("recovery_refused", reason="multihost",
-                       attempt=attempt_no, error=repr(err)[:300])
+                       retry=attempt_no, error=repr(err)[:300])
             raise err
 
     def _latest_durable():
@@ -880,7 +893,10 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
             cfg_try = copy.deepcopy(cfg_try)
             cfg_try.optim.lr *= cfg.resilience.nan_lr_factor
             cfg_try.train.resume = cfg.train.resume or resume_step is not None
-            logger.log("recovery", cause="divergence", attempt=nan_attempts,
+            # "retry", not "attempt": attempt is the lineage stamp's field
+            # (the elastic relaunch counter) — an in-process retry must not
+            # masquerade as a supervisor attempt in the postmortem.
+            logger.log("recovery", cause="divergence", retry=nan_attempts,
                        retries_left=cfg.resilience.nan_retry_budget - nan_attempts,
                        resume=cfg_try.train.resume, resume_step=resume_step,
                        lr=cfg_try.optim.lr, error=repr(err)[:300])
@@ -891,14 +907,14 @@ def fit_with_recovery(cfg: Config, train_ds: ArrayDataset,
                 raise
             fault = ("hang" if isinstance(err, WatchdogTimeout)
                      else "step_exception")
-            logger.fault(fault, attempt=attempt, error=repr(err)[:300])
+            logger.fault(fault, retry=attempt, error=repr(err)[:300])
             # Final moments BEFORE the retry re-enters fit and the ring
             # starts filling with the new attempt's events. (The watchdog
             # already dumped at fire time from its monitor thread; this
             # overwrite adds the fault event itself to the ring.)
             flightrec.dump(f"{fault}:attempt{attempt}")
             resume_step = _latest_durable()
-            logger.log("recovery", cause="exception", attempt=attempt,
+            logger.log("recovery", cause="exception", retry=attempt,
                        retries_left=cfg.train.auto_resume_retries - attempt,
                        resume=cfg.train.resume or resume_step is not None,
                        error=repr(err)[:300])

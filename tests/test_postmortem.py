@@ -1,0 +1,383 @@
+"""Run lineage & postmortem forensics (ISSUE 12 acceptance).
+
+The heavy lane reuses the session-scoped 2-proc SIGKILL→shrink drill
+(tests/conftest.py ``elastic_drill`` — ONE run shared with
+tests/test_elastic.py): ``tools/postmortem.py`` must reconstruct the full
+chain — triggering fault → dead rank 1 → shrink 2→1 → resume step and
+saved_world → finite recovery wall — with every emitted record
+lineage-stamped and schema-validated, the crashed attempt's artifacts
+preserved (attempt-suffixed traces, archived heartbeat residue), the merged
+Perfetto trace carrying one lane per (attempt, rank), and the 0/1/2 exit
+contract pinned (clean drill → 0; blown recovery budget → 1; synthetic
+unexplained attempt gap → 1 from postmortem AND run_monitor; unreadable →
+2). Unit lanes pin the lineage stamping of both logger types and the SLO
+engine's cross-attempt recovery objective without subprocesses.
+"""
+
+import json
+import os
+import sys
+import time
+
+from data_diet_distributed_tpu.obs import lineage
+from data_diet_distributed_tpu.obs import timeline as tl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import postmortem  # noqa: E402
+import run_monitor  # noqa: E402
+
+
+# ------------------------------------------------------ lineage stamping
+
+
+def _with_lineage(lin):
+    """Install a known lineage for the test body; the previous one is
+    restored by the caller via the returned token."""
+    prev = lineage.current()
+    lineage.install(lin)
+    return prev
+
+
+def test_metrics_logger_stamps_lineage_on_every_record(tmp_path):
+    from data_diet_distributed_tpu.obs import MetricsLogger
+    prev = _with_lineage(lineage.Lineage(run_id="runA", attempt=3, world=2))
+    try:
+        logger = MetricsLogger(str(tmp_path / "m.jsonl"), echo=False)
+        logger.log("epoch", epoch=0, train_loss=0.5)
+        # Explicit fields are the emitter's authority — never overwritten.
+        logger.log("resume", tag="t", step=4, world=7)
+        logger.close()
+    finally:
+        lineage.install(prev) if prev else lineage.uninstall()
+    recs = [json.loads(ln) for ln in open(tmp_path / "m.jsonl")]
+    assert all(r["run_id"] == "runA" and r["attempt"] == 3 for r in recs)
+    assert recs[0]["world"] == 2
+    assert recs[1]["world"] == 7   # explicit wins
+
+
+def test_jsonl_logger_stamps_lineage_too(tmp_path):
+    from data_diet_distributed_tpu.resilience.elastic import JsonlLogger
+    prev = _with_lineage(lineage.Lineage(run_id="runB", attempt=1))
+    try:
+        logger = JsonlLogger(str(tmp_path / "s.jsonl"), echo=False)
+        logger.log("elastic_event", event="launch", attempt=2, world=4)
+        logger.close()
+    finally:
+        lineage.install(prev) if prev else lineage.uninstall()
+    rec = json.loads(open(tmp_path / "s.jsonl").read())
+    assert rec["run_id"] == "runB"
+    assert rec["attempt"] == 2    # the supervisor's explicit attempt wins
+    assert rec["world"] == 4
+
+
+def test_lineage_from_env_and_child_env_roundtrip():
+    env = lineage.child_env("rid", 5, 3)
+    lin = lineage.from_env(env)
+    assert (lin.run_id, lin.attempt, lin.world) == ("rid", 5, 3)
+    # Absent/garbled env: fresh run_id, attempt 0, no world.
+    lin = lineage.from_env({"DDT_ELASTIC_ATTEMPT": "soon"})
+    assert lin.attempt == 0 and lin.world is None and lin.run_id
+
+
+def test_attempt_suffixed_artifact_names():
+    from data_diet_distributed_tpu.obs.flightrec import flightrec_path
+    from data_diet_distributed_tpu.obs.tracing import (trace_coords,
+                                                       trace_path_for)
+    assert lineage.attempt_suffix(0) == ""
+    assert lineage.suffixed_path("/w/trace.json", 2) == "/w/trace_a2.json"
+    assert lineage.attempt_of("flightrec_rank1_a3.json") == 3
+    assert lineage.attempt_of("flightrec_rank1.json") == 0
+    assert trace_path_for("/w/t.json", 0, 0) == "/w/t.json"
+    assert trace_path_for("/w/t.json", 1, 2) == "/w/t_a2_rank1.json"
+    assert trace_coords("/w/t.json", "/w/t_a2_rank1.json") == (2, 1)
+    assert trace_coords("/w/t.json", "/w/t_report.json") is None
+    assert flightrec_path("/w", 0, 1) == "/w/flightrec_rank0_a1.json"
+
+
+def test_heartbeat_archive_preserves_residue(tmp_path):
+    from data_diet_distributed_tpu.obs.heartbeat import (
+        Heartbeat, archive_heartbeat, read_heartbeat_residue,
+        read_heartbeats)
+    hb_dir = str(tmp_path / "hb")
+    Heartbeat(hb_dir, 1, min_interval_s=0).beat(step=7, stage="dense",
+                                                force=True)
+    assert archive_heartbeat(hb_dir, 1, attempt=0)
+    # The live view no longer reports the ghost...
+    assert read_heartbeats(hb_dir) == {}
+    # ...but the evidence survives, attributed to (rank, attempt).
+    residue = read_heartbeat_residue(hb_dir)
+    assert len(residue) == 1
+    assert residue[0]["rank"] == 1 and residue[0]["attempt"] == 0
+    assert residue[0]["step"] == 7
+    # Archiving an absent file reports False, never raises.
+    assert not archive_heartbeat(hb_dir, 9, attempt=0)
+
+
+# ------------------------------------------------ SLO: recovery objective
+
+
+def _stream(tmp_path, records):
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+class _ListLogger:
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, **fields})
+
+
+def test_slo_recovery_objective_cross_attempt(tmp_path):
+    from data_diet_distributed_tpu.obs.slo import SloEngine
+    now = time.time()
+    stream = _stream(tmp_path, [
+        {"ts": now - 100, "kind": "epoch", "epoch": 0, "train_loss": 1.0,
+         "attempt": 0},
+        {"ts": now - 50, "kind": "elastic_event", "event": "children_exited",
+         "action": "shrink", "attempt": 0},
+    ])
+    prev = _with_lineage(lineage.Lineage(run_id="r", attempt=1))
+    try:
+        # Within budget: gauge recorded, no violation.
+        ok = SloEngine(recovery_s=120.0)
+        assert ok.arm_recovery(stream)
+        log = _ListLogger()
+        ok.note_training_step(logger=log, now=now - 40)   # 10 s recovery
+        assert ok.total_violations == 0 and log.records == []
+        # A second training step is not a second verdict.
+        ok.note_training_step(logger=log, now=now)
+        assert ok.total_violations == 0
+
+        # Over budget: one violation naming the objective and attempt.
+        bad = SloEngine(recovery_s=5.0)
+        assert bad.arm_recovery(stream)
+        bad.note_training_step(logger=log, now=now)        # 50 s recovery
+        assert bad.total_violations == 1
+        assert log.records[-1]["kind"] == "slo_violation"
+        assert log.records[-1]["slo"] == "recovery"
+        assert log.records[-1]["attempt"] == 1
+        assert log.records[-1]["value"] > 5.0
+    finally:
+        lineage.install(prev) if prev else lineage.uninstall()
+
+
+def test_slo_recovery_never_arms_on_attempt_zero(tmp_path):
+    from data_diet_distributed_tpu.obs.slo import SloEngine
+    stream = _stream(tmp_path, [
+        {"ts": time.time(), "kind": "elastic_event",
+         "event": "children_exited", "attempt": 0}])
+    prev = _with_lineage(lineage.Lineage(run_id="r", attempt=0))
+    try:
+        engine = SloEngine(recovery_s=1.0)
+        assert not engine.arm_recovery(stream)
+        engine.note_training_step()   # unarmed: a no-op, never a verdict
+        assert engine.total_violations == 0
+    finally:
+        lineage.install(prev) if prev else lineage.uninstall()
+
+
+# --------------------------------------------- postmortem: the kill drill
+
+
+def _run_postmortem(argv, capsys):
+    rc = postmortem.main(argv)
+    out = capsys.readouterr().out.strip().splitlines()
+    return rc, out
+
+
+def test_postmortem_reconstructs_kill_shrink_chain(elastic_drill, capsys,
+                                                   tmp_path):
+    """ISSUE 12 acceptance: the postmortem over the real 2-proc drill names
+    the whole chain and exits 0; every record is lineage-stamped."""
+    assert elastic_drill["rc"] == 0, elastic_drill["logs"][-3000:]
+    drill_dir = elastic_drill["dir"]
+    records = elastic_drill["records"]
+
+    # Every record of every attempt is lineage-stamped with ONE run_id.
+    assert all("run_id" in r and "attempt" in r for r in records), \
+        [r for r in records if "run_id" not in r][:3]
+    assert len({r["run_id"] for r in records}) == 1
+    assert {r["attempt"] for r in records} == {0, 1}
+
+    merged = tmp_path / "merged_trace.json"
+    rc, out = _run_postmortem([str(drill_dir), "--json",
+                               "--perfetto", str(merged)], capsys)
+    assert rc == 0, out
+    report = json.loads(out[-1])
+    assert report["ok"] is True and report["exit_code"] == 0
+    assert report["attempts"] == 2
+    assert report["run_id"] == records[0]["run_id"]
+    assert report["worlds"] == [2, 1]
+
+    chains = [c for c in report["recoveries"] if c["type"] == "relaunch"]
+    assert len(chains) == 1
+    c = chains[0]
+    # fault → dead rank 1 → shrink 2→1 → resume step/saved_world → wall.
+    assert c["action"] == "shrink"
+    assert c["dead_ranks"] == [1]
+    assert c["new_world"] == 1
+    assert c["from_attempt"] == 0 and c["to_attempt"] == 1
+    assert c["resume_step"] in (4, 8)
+    assert c["saved_world"] == 2
+    assert c["recovery_wall_s"] is not None
+    assert 0 < c["recovery_wall_s"] < 300
+    assert c["explained"] is True
+    # The triggering fault is named even though the bounded multi-host exit
+    # never logged it to the stream — the survivor's flight-recorder dump
+    # is the testimony the postmortem falls back to.
+    assert c["trigger"] is not None
+    assert c["trigger"]["rank"] == 0
+    # The tier manifests joined in: the restored step was written at world 2.
+    assert any(t["step"] == c["resume_step"] and t["world"] == 2
+               for t in report["tier_steps"]), report["tier_steps"]
+
+    # The crashed attempt's evidence survived the recovery: attempt 0's
+    # trace still on disk NEXT TO attempt 1's (no clobber), and the dead
+    # rank's heartbeat archived as residue the report attributes.
+    assert (drill_dir / "trace.json").exists()
+    assert (drill_dir / "trace_a1.json").exists()
+    assert any(r.get("rank") == 1 for r in report["heartbeat_residue"])
+
+    # Merged Perfetto: one lane per (attempt, rank).
+    lanes = {e["args"]["name"] for e in json.load(open(merged))
+             if e.get("name") == "process_name"}
+    assert {"attempt0/rank0", "attempt0/rank1", "attempt1/rank0"} <= lanes
+
+    # The in-process recovery SLO evaluated on the relaunched attempt:
+    # verdict ok (within the drill's generous budget), objective recorded.
+    worker_summaries = [r for r in records if r.get("kind") == "run_summary"
+                        and r.get("attempt") == 1 and "slo" in r]
+    assert worker_summaries, [r for r in records
+                              if r.get("kind") == "run_summary"]
+    slo = worker_summaries[-1]["slo"]
+    assert slo["ok"] is True and slo["violations"] == 0
+    assert slo["objectives"]["recovery_s"] == 240
+
+    # Human rendering names the same chain (smoke, not snapshot).
+    rc, out = _run_postmortem([str(drill_dir)], capsys)
+    assert rc == 0
+    text = "\n".join(out)
+    assert "shrink" in text and "dead ranks [1]" in text
+    assert "saved_world=2" in text
+
+
+def test_postmortem_recovery_budget_exit_1(elastic_drill, capsys):
+    """The same clean drill is OUT of contract under an impossible recovery
+    budget — the budget arm of the exit contract, over real artifacts."""
+    assert elastic_drill["rc"] == 0
+    rc, out = _run_postmortem([str(elastic_drill["dir"]), "--json",
+                               "--recovery-budget-s", "0.001"], capsys)
+    assert rc == 1
+    report = json.loads(out[-1])
+    assert any("budget" in p for p in report["problems"])
+
+
+def test_trace_report_merges_attempts_from_directory(elastic_drill, capsys):
+    import trace_report
+    assert elastic_drill["rc"] == 0
+    rc = trace_report.main([str(elastic_drill["dir"]), "--json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    report = json.loads(out[-1])
+    assert report["attempts"] == [0, 1]
+    assert 0 in report["ranks"]
+
+
+# ------------------------------------------ postmortem: clean + contract
+
+
+def test_postmortem_clean_single_process_run_exits_0(tmp_path, capsys):
+    """A clean in-process run (attempt 0, terminal ok): exit 0, no chains,
+    stamped stream."""
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.obs import MetricsLogger, emit_run_summary
+    from data_diet_distributed_tpu.obs.session import ObsSession
+    from data_diet_distributed_tpu.train.loop import fit, load_data_for
+    cfg = load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=128",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=1",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+    ])
+    prev = _with_lineage(lineage.from_env({}))   # fresh attempt-0 identity
+    try:
+        train_ds, test_ds = load_data_for(cfg)
+        logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+        with ObsSession(cfg, logger=logger) as obs:
+            fit(cfg, train_ds, test_ds, logger=logger)
+            emit_run_summary(logger, wall_s=1.0, exit_class="ok",
+                             command="train", registry=obs.registry)
+        logger.close()
+    finally:
+        lineage.install(prev) if prev else lineage.uninstall()
+    rc, out = _run_postmortem([str(tmp_path), "--json"], capsys)
+    assert rc == 0, out
+    report = json.loads(out[-1])
+    assert report["ok"] is True
+    assert report["attempts"] == 1 and report["attempt_ids"] == [0]
+    assert report["recoveries"] == [] and report["unexplained"] == []
+    assert report["terminal"]["exit_class"] == "ok"
+    # The stream validates with the lineage fields present.
+    from validate_metrics import validate_file
+    assert not validate_file(cfg.obs.metrics_path)
+
+
+def test_postmortem_unreadable_exits_2(tmp_path, capsys):
+    rc, out = _run_postmortem([str(tmp_path / "nowhere.jsonl"), "--json"],
+                              capsys)
+    assert rc == 2
+    assert json.loads(out[-1])["exit_code"] == 2
+
+
+def test_unexplained_attempt_gap_is_nonzero_everywhere(tmp_path, capsys):
+    """Records from attempt 2 with NO supervisor events: the lineage is
+    broken — postmortem exits 1 and run_monitor --once (files mode, pinned
+    multi-attempt contract) agrees, even though every individual record
+    looks healthy."""
+    now = time.time()
+    stream = _stream(tmp_path, [
+        {"ts": now - 60, "kind": "epoch", "epoch": 0, "train_loss": 0.5,
+         "run_id": "r1", "attempt": 0},
+        {"ts": now - 30, "kind": "epoch", "epoch": 1, "train_loss": 0.4,
+         "run_id": "r1", "attempt": 2},
+        {"ts": now - 10, "kind": "run_summary", "wall_s": 50.0,
+         "exit_class": "ok", "run_id": "r1", "attempt": 2},
+    ])
+    rc, out = _run_postmortem([stream, "--json"], capsys)
+    assert rc == 1
+    report = json.loads(out[-1])
+    assert report["unexplained"], report
+    rc = run_monitor.main(["--metrics", stream, "--once", "--json"])
+    view = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1, view
+    assert view["lineage"]["unexplained"]
+    # The same stream WITH the supervisor's explanation is healthy: a
+    # recovered-within-contract lineage exits 0.
+    explained = _stream(tmp_path, [
+        {"ts": now - 60, "kind": "epoch", "epoch": 0, "train_loss": 0.5,
+         "run_id": "r1", "attempt": 0},
+        {"ts": now - 50, "kind": "elastic_event", "event": "children_exited",
+         "action": "shrink", "run_id": "r1", "attempt": 0},
+        {"ts": now - 45, "kind": "elastic_event", "event": "shrink",
+         "dead_ranks": [1], "new_world": 1, "run_id": "r1", "attempt": 0},
+        {"ts": now - 40, "kind": "elastic_event", "event": "launch",
+         "world": 1, "run_id": "r1", "attempt": 1},
+        {"ts": now - 30, "kind": "epoch", "epoch": 1, "train_loss": 0.4,
+         "run_id": "r1", "attempt": 1},
+        {"ts": now - 10, "kind": "run_summary", "wall_s": 50.0,
+         "exit_class": "ok", "run_id": "r1", "attempt": 1},
+    ])
+    rc, out = _run_postmortem([explained, "--json"], capsys)
+    assert rc == 0, out
+    rc = run_monitor.main(["--metrics", explained, "--once", "--json"])
+    capsys.readouterr()
+    assert rc == 0

@@ -7,8 +7,11 @@ long-haul soak.
 SIGTERM preemptions, rank-targeted SIGKILL host kills, NaN losses, hang
 stalls, host rejoins — one fault per cycle, each cycle judged by
 ``tools/run_monitor.py --once`` exit codes (0 healthy / 1 SLO-violated /
-2 unreachable-or-stale) and the SLO engine's verdict in the terminal
-``run_summary``. The driver emits one ``{"kind": "soak_report"}`` record
+2 unreachable-or-stale), the SLO engine's verdict in the terminal
+``run_summary``, AND ``tools/postmortem.py``'s whole-lineage forensics
+verdict (every recovery's chain must be explained by the records it left;
+the per-cycle ``postmortem_report`` is embedded in the soak stream and the
+cycle verdicts). The driver emits one ``{"kind": "soak_report"}`` record
 (and prints it as the final JSON line); exit 0 iff every cycle recovered
 and every monitor verdict was healthy.
 
@@ -190,12 +193,14 @@ def _cycle_overrides(args, cycle_dir: str, fault: str) -> list[str]:
 
 def _judge_cycle(cycle_dir: str) -> dict:
     """``run_monitor --once --json`` over the cycle's metrics stream (files
-    mode: a finished run is judged by its records) + the stream's schema
-    validation — the soak's per-cycle verdict."""
+    mode: a finished run is judged by its records), the stream's schema
+    validation, AND the postmortem engine's whole-lineage verdict
+    (``tools/postmortem.py`` — every recovery's chain must be explained by
+    the records it left) — the soak's per-cycle verdict."""
     import subprocess
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
     metrics = os.path.join(cycle_dir, "metrics.jsonl")
-    monitor = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "run_monitor.py")
+    monitor = os.path.join(tools_dir, "run_monitor.py")
     proc = subprocess.run(
         [sys.executable, monitor, "--metrics", metrics, "--once", "--json"],
         capture_output=True, text=True, timeout=60)
@@ -208,6 +213,15 @@ def _judge_cycle(cycle_dir: str) -> dict:
         problems = validate_file(metrics)
     except OSError as err:
         problems = [f"{metrics}: unreadable ({err})"]
+    pm = subprocess.run(
+        [sys.executable, os.path.join(tools_dir, "postmortem.py"),
+         cycle_dir, "--json"],
+        capture_output=True, text=True, timeout=60)
+    try:
+        pm_report = json.loads(pm.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        pm_report = {"problems": [f"unparseable postmortem output: "
+                                  f"{pm.stdout[-200:]}"]}
     summary = view.get("run_summary") or {}
     return {
         "monitor_exit": proc.returncode,
@@ -215,6 +229,21 @@ def _judge_cycle(cycle_dir: str) -> dict:
         "slo": summary.get("slo"),
         "violations": len(view.get("violations") or []),
         "stream_problems": problems[:5],
+        "postmortem_exit": pm.returncode,
+        "postmortem": {
+            "run_id": pm_report.get("run_id"),
+            "attempts": pm_report.get("attempts"),
+            # The chain list, verbatim — this block is re-emitted under the
+            # same `postmortem_report` kind postmortem.py itself uses, and
+            # one registered kind must mean ONE shape (`recoveries` is a
+            # list of chains, never a count).
+            "recoveries": pm_report.get("recoveries") or [],
+            "recovery_walls_s": [c.get("recovery_wall_s")
+                                 for c in pm_report.get("recoveries") or []],
+            "lost_wall_s": pm_report.get("lost_wall_s"),
+            "ok": pm_report.get("ok"),
+            "problems": (pm_report.get("problems") or [])[:5],
+        },
     }
 
 
@@ -292,15 +321,22 @@ def soak_main(args) -> int:
             **verdict,
         }
         rec["recovered"] = bool(rc == 0 and verdict["monitor_exit"] == 0
+                                and verdict["postmortem_exit"] == 0
                                 and not verdict["stream_problems"])
         cycles.append(rec)
         driver_log.log("elastic_event", event="soak_cycle", **rec)
+        # The forensics verdict as its own schema-registered record — the
+        # soak stream is where a long-haul report's reader looks first.
+        driver_log.log("postmortem_report", cycle=i, fault=fault,
+                       exit_code=verdict["postmortem_exit"],
+                       **verdict["postmortem"])
     ok = bool(cycles) and all(c["recovered"] for c in cycles)
     report = {
         "cycles": len(cycles), "ok": ok,
         "faults": [c["fault"] for c in cycles],
         "recovered": sum(c["recovered"] for c in cycles),
         "monitor_exits": [c["monitor_exit"] for c in cycles],
+        "postmortem_exits": [c["postmortem_exit"] for c in cycles],
         "recovery_wall_s": [c["wall_s"] for c in cycles],
         "world": args.world, "smoke": bool(args.smoke),
         "wall_s": round(time.perf_counter() - t0, 1),

@@ -19,7 +19,9 @@ CI exit contract (``--once``; pinned by tests/test_run_monitor.py)::
 
     0  healthy — verdict ok, no SLO violations
     1  SLO violated (or the run is degraded/critical for a non-staleness
-       reason): the run is alive but out of contract
+       reason): the run is alive but out of contract. In files mode this
+       also covers a broken LINEAGE: an attempt gap the supervisor's
+       records do not explain (``obs/timeline.py``'s judgment)
     2  unreachable or stale: no server AND no readable artifacts, heartbeats
        past --stale-after with no terminal run_summary, or a critical
        verdict (poison / fired watchdog) — the run needs an operator, not a
@@ -27,7 +29,13 @@ CI exit contract (``--once``; pinned by tests/test_run_monitor.py)::
 
 A finished run (its stream ends with the ``run_summary`` terminal event) is
 judged by its records, not by heartbeat age: 1 if it recorded SLO
-violations, else 0 — so the same command works as a post-run gate.
+violations, else 0 — so the same command works as a post-run gate. With
+lineage-stamped streams (``obs/lineage.py``) the judgment covers the WHOLE
+elastic lineage, not the last attempt: a run that lost a host, shrank, and
+recovered is healthy (exit 0) as long as every attempt transition is
+explained by the supervisor's records — while an attempt that appears with
+no explaining launch/classification exits 1 even though its own records
+look clean.
 """
 
 from __future__ import annotations
@@ -97,9 +105,14 @@ def tail_records(path: str, kinds: tuple[str, ...] | None = None,
 
 
 def gather_files(metrics: str | None, heartbeat_dir: str | None,
-                 stale_after_s: float) -> dict:
+                 stale_after_s: float, lineage: bool = True) -> dict:
     """The dead-run view from on-disk artifacts: fleet from heartbeats,
-    progress/violations/terminal state from the metrics stream."""
+    progress/violations/terminal state from the metrics stream.
+
+    ``lineage=False`` skips the whole-lineage judgment: it materializes the
+    FULL stream (the judgment needs resume/fault/training records the
+    display tail filters out), which is a per-tick O(stream) cost the watch
+    loop must not pay — the judgment gates the ``--once`` CI verdict."""
     out: dict = {"source": "files", "unreachable": False}
     now = time.time()
     if heartbeat_dir:
@@ -111,6 +124,27 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
         recs = tail_records(metrics, ("epoch", "run_summary", "slo_violation",
                                       "fleet_status", "summary",
                                       "elastic_event", "soak_report"))
+        view = None
+        if lineage:
+            from data_diet_distributed_tpu.obs.timeline import (lineage_view,
+                                                                read_records)
+            view = lineage_view(read_records(metrics))
+        if view is not None:
+            # Headline counts exclude requested grow/resize transitions —
+            # same semantics as the supervisor's run_summary lineage block
+            # (a requested grow is not a failure recovery).
+            failures = [c for c in view["recoveries"]
+                        if not c.get("requested")]
+            out["lineage"] = {
+                "run_ids": view["run_ids"],
+                "attempts": view["attempts"],
+                "worlds": view["worlds"],
+                "recoveries": len(failures),
+                "recovery_walls_s": [c.get("recovery_wall_s")
+                                     for c in failures],
+                "unexplained": view["unexplained"],
+                "lost_wall_s": view["lost_wall_s"],
+            }
         ts = [r["ts"] for r in recs if isinstance(r.get("ts"), (int, float))]
         if ts:
             # Liveness of the STREAM itself: a run with no terminal record
@@ -189,6 +223,12 @@ def decide_exit(info: dict, stale_after_s: float) -> int:
             return EXIT_UNREACHABLE
     if info.get("violations"):
         return EXIT_SLO
+    if (info.get("lineage") or {}).get("unexplained"):
+        # A recovered-within-contract lineage is healthy — that's the whole
+        # point of elastic — but an attempt that exists with no supervisor
+        # record explaining it means evidence was lost or something
+        # relaunched outside the control plane: out of contract.
+        return EXIT_SLO
     return EXIT_HEALTHY
 
 
@@ -255,6 +295,13 @@ def render(info: dict) -> str:
                      f"{el['shrinks']} shrink / {el['grows']} grow / "
                      f"{el['restarts']} restart; last={el['last']} "
                      f"world={el['world']}")
+    lin = info.get("lineage")
+    if lin:
+        lines.append(f"lineage: {lin['attempts']} attempt(s), worlds "
+                     f"{lin['worlds'] or '[?]'}, {lin['recoveries']} "
+                     f"recovery(ies), lost wall {lin['lost_wall_s']}s")
+        for u in lin["unexplained"]:
+            lines.append(f"  UNEXPLAINED: {u}")
     soak = info.get("soak_report")
     if soak:
         verdict = "ok" if soak.get("ok") else "NOT ok"
@@ -277,7 +324,8 @@ def gather(args) -> dict:
         return info
     if args.metrics or args.heartbeat_dir:
         files = gather_files(args.metrics, args.heartbeat_dir,
-                             args.stale_after)
+                             args.stale_after,
+                             lineage=bool(getattr(args, "once", False)))
         if info is not None:
             files["server_error"] = info.get("error")
         return files

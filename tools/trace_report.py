@@ -7,9 +7,16 @@ four without opening a trace viewer::
 
     python tools/trace_report.py <workdir>/trace.json
     python tools/trace_report.py trace.json trace_rank1.json   # merged view
+    python tools/trace_report.py <workdir>            # ALL attempts + ranks
     python tools/trace_report.py trace.json --heartbeats ./ckpt_heartbeats
     python tools/trace_report.py trace.json --metrics metrics.jsonl  # + XLA
     python tools/trace_report.py trace.json --json             # machine-readable
+
+A directory argument discovers and merges every per-(attempt, rank) trace
+of the run (``trace.json``, ``trace_a1.json``, ``trace_a1_rank1.json``, … —
+the elastic supervisor's relaunches write attempt-suffixed traces instead
+of clobbering the crashed attempt's, ``obs/lineage.py``), so one command
+summarizes the whole lineage.
 
 Reads crashed-run traces too (the streamed format tolerates a missing
 terminating ``]`` — ``obs.tracing.read_trace``). The per-stage breakdown uses
@@ -30,7 +37,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from data_diet_distributed_tpu.obs.heartbeat import (describe_beats,  # noqa: E402
                                                      read_heartbeats)
 from data_diet_distributed_tpu.obs.profiler import percentile  # noqa: E402
-from data_diet_distributed_tpu.obs.tracing import read_trace  # noqa: E402
+from data_diet_distributed_tpu.obs.tracing import (discover_traces,  # noqa: E402
+                                                   read_trace)
 
 #: Inter-event gaps shorter than this are loop bookkeeping, not stalls.
 DEFAULT_GAP_S = 1.0
@@ -171,7 +179,9 @@ def render(report: dict, heartbeats: dict[int, dict] | None = None,
            now: float | None = None) -> str:
     lines = [f"trace: {report['events']} events, {report['spans']} spans, "
              f"{report['trace_total_s']}s span, "
-             f"ranks {report['ranks']}"]
+             f"ranks {report['ranks']}"
+             + (f", attempts {report['attempts']}"
+                if report.get("attempts") else "")]
     if report["stages"]:
         lines.append("per-stage breakdown:")
         lines += [_fmt_summary(n, s) for n, s in report["stages"].items()]
@@ -245,13 +255,28 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     events: list[dict] = []
+    attempts: set[int] = set()
     for path in args.trace:
-        events.extend(read_trace(path))
+        if os.path.isdir(path):
+            # A run directory: merge EVERY per-(attempt, rank) trace it
+            # holds. Attempts share pid=rank lanes in the summary; the
+            # attempt set is reported so a multi-attempt merge is explicit.
+            rows = discover_traces(os.path.join(path, "trace.json"))
+            if not rows:
+                print(f"no trace*.json in directory {path}",
+                      file=sys.stderr)
+            for row in rows:
+                events.extend(read_trace(row["path"]))
+                attempts.add(row["attempt"])
+        else:
+            events.extend(read_trace(path))
     if not events:
         print(f"no events in {args.trace}", file=sys.stderr)
         return 1
     report = summarize(events, top_chunks=args.top_chunks,
                        gap_threshold_s=args.gap_threshold)
+    if attempts:
+        report["attempts"] = sorted(attempts)
     if args.metrics is not None:
         report["xla"] = xla_section(args.metrics)
     beats = (read_heartbeats(args.heartbeats)

@@ -60,6 +60,10 @@ KNOWN_KINDS = frozenset({
     # supervisor decisions (launch/shrink/grow/restart/give_up, stage-
     # boundary resize honors) and the soak driver's terminal verdict.
     "elastic_event", "soak_report",
+    # Postmortem engine (obs/timeline.py + tools/postmortem.py): the
+    # whole-lineage forensics verdict — emitted by `postmortem --json` and
+    # embedded per cycle by the soak driver.
+    "postmortem_report",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -107,6 +111,10 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     # universal; per-event payloads ride as optional fields.
     "elastic_event": ("event",),
     "soak_report": ("cycles", "ok"),
+    # Postmortem verdict. Null-tolerant like xla_program: run_id is null
+    # over a pre-lineage stream, recoveries may be empty — the KEYS must be
+    # present so consumers can rely on the shape.
+    "postmortem_report": ("attempts", "recoveries", "ok"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
@@ -146,6 +154,19 @@ def validate_lines(lines, *, where: str = "<stream>",
             problems.append(f"{where}:{i}: unknown kind {kind!r}")
             continue
         last_kind = kind
+        # Lineage fields (obs/lineage.py) ride EVERY kind, null-tolerant:
+        # a pre-lineage stream omits them entirely, but a present stamp
+        # must be well-typed — the postmortem joins on these.
+        if "run_id" in rec and rec["run_id"] is not None \
+                and not isinstance(rec["run_id"], str):
+            problems.append(f"{where}:{i}: 'run_id' must be a string or "
+                            "null")
+        if "attempt" in rec and rec["attempt"] is not None \
+                and not (isinstance(rec["attempt"], int)
+                         and not isinstance(rec["attempt"], bool)
+                         and rec["attempt"] >= 0):
+            problems.append(f"{where}:{i}: 'attempt' must be a "
+                            "non-negative integer or null")
         for field in REQUIRED_FIELDS.get(kind, ()):
             if field not in rec:
                 problems.append(
