@@ -132,7 +132,7 @@ def _slo_verdict(metric: str, value: float, unit: str) -> dict | None:
         if baseline is None:
             return {"verdict": "no-baseline"}
         delta = (value - baseline) / baseline
-        if unit in ("seconds", "s"):
+        if unit in ("seconds", "s", "ms"):
             delta = -delta   # lower-better: normalize so positive = better
         return {"verdict": "violated" if delta < -SLO_THRESHOLD else "ok",
                 "baseline": round(baseline, 2), "delta_frac": round(delta, 4),
@@ -256,7 +256,7 @@ def parse_mesh(spec: str | None):
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--task", default="score",
-                        choices=["score", "train", "northstar"],
+                        choices=["score", "train", "northstar", "serve"],
                         help="score = GraNd/EL2N scoring throughput (the "
                              "headline metric); train = epoch training "
                              "throughput with device-resident data; "
@@ -264,7 +264,11 @@ def main() -> None:
                              "(full GraNd, --size examples x --seeds "
                              "scoring models through the production "
                              "score_dataset driver), reported as wall "
-                             "seconds vs the 60 s budget")
+                             "seconds vs the 60 s budget; serve = boot the "
+                             "scoring service in-process, drive a measured "
+                             "request load (--rps x --duration via "
+                             "tools/serve_client.py), report p95 request "
+                             "latency + coalesced-dispatch stats")
     parser.add_argument("--size", type=int, default=8192,
                         help="examples in the scoring pass")
     parser.add_argument("--batch", type=int, default=2048)
@@ -345,6 +349,13 @@ def main() -> None:
     parser.add_argument("--prom-path", default=None,
                         help="also write the registry's Prometheus textfile "
                              "(MFU/flops/compile-time/HBM gauges) here")
+    parser.add_argument("--rps", type=float, default=25.0,
+                        help="serve task: offered request rate for the "
+                             "measured load window (open loop)")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="serve task: measured load window in seconds")
+    parser.add_argument("--request-batch", type=int, default=16,
+                        help="serve task: examples per /v1/score request")
     parser.add_argument("--serve-port", type=int, default=None,
                         help="serve the live obs endpoints (/healthz "
                              "/metrics /status /flightrec) for the duration "
@@ -375,8 +386,10 @@ def main() -> None:
 
     metric = {"score": f"{args.method}_scoring_examples_per_sec_per_chip",
               "train": "train_examples_per_sec_per_chip",
-              "northstar": "grand_northstar_wall_s"}[args.task]
-    unit = "seconds" if args.task == "northstar" else "examples/sec/chip"
+              "northstar": "grand_northstar_wall_s",
+              "serve": f"{args.method}_serve_request_p95_ms"}[args.task]
+    unit = {"northstar": "seconds", "serve": "ms"}.get(args.task,
+                                                       "examples/sec/chip")
 
     if not args.no_probe:
         info = probe_backend(args.probe_attempts, args.probe_timeout,
@@ -461,6 +474,8 @@ def main() -> None:
                     bench_train(args, metric)
                 elif args.task == "northstar":
                     bench_northstar(args, metric)
+                elif args.task == "serve":
+                    bench_serve(args, metric)
                 else:
                     bench_score(args, metric)
         finally:
@@ -783,6 +798,87 @@ def bench_northstar(args, metric: str) -> None:
          round(budget_s / wall, 4), size=args.size, seeds=args.seeds,
          examples_per_sec_per_chip=round(
              args.size * args.seeds / wall / len(jax.devices()), 1))
+
+
+#: Serve-task budget: warm p95 request latency the CPU lane should beat
+#: comfortably (the vs_baseline denominator; the ledger trail is the real
+#: regression judge, per-shape like every other metric).
+SERVE_BUDGET_P95_MS = 100.0
+
+
+def bench_serve(args, metric: str) -> None:
+    """Scoring-as-a-service latency through the PRODUCTION service: boot
+    ``ServeEngine`` + ``ServeService`` in-process over a synthetic dataset,
+    pay the cold-start (first request compiles the request-geometry
+    program) explicitly, then drive ``--rps`` x ``--duration`` of
+    ``/v1/score`` load with ``tools/serve_client.py``'s open-loop generator.
+    Reported value = warm p95 request latency (ms, lower-better in the
+    ledger); the JSON carries p50/max, the cold-vs-warm split, 429/ error
+    counts, and the batcher's coalesced-dispatch stats."""
+    import importlib.util
+
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.serve.engine import ServeEngine
+    from data_diet_distributed_tpu.serve.server import ServeService
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_client", os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "tools", "serve_client.py"))
+    serve_client = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_client)
+
+    stem = args.stem or ("imagenet" if args.dataset == "synthetic_imagenet"
+                         else "cifar")
+    overrides = [
+        f"data.dataset={args.dataset}", f"data.synthetic_size={args.size}",
+        f"model.arch={args.arch}", f"model.stem={stem}",
+        f"score.method={args.method}", "score.pretrain_epochs=0",
+        f"score.batch_size={args.batch}", f"score.grand_chunk={args.grand_chunk}",
+        "serve.port=0", "serve.request_log=false", "serve.tenant=bench",
+    ]
+    if args.no_pallas:
+        overrides.append("score.use_pallas=false")
+    if args.mesh:
+        d, m = parse_mesh(args.mesh)
+        overrides += [f"mesh.data_axis={d}", f"mesh.model_axis={m}"]
+    cfg = load_config(None, overrides)
+    train_ds, _ = load_dataset(args.dataset, synthetic_size=args.size, seed=0)
+    engine = ServeEngine(cfg)
+    engine.register_tenant("bench", train_ds)
+    service = ServeService(engine, cfg)
+    if not service.start():
+        raise RuntimeError("serve bench: service failed to bind a port")
+    try:
+        url = f"http://127.0.0.1:{service.port}"
+        client = serve_client.ServeClient(url, timeout_s=600.0)
+        ids = list(range(min(args.request_batch, len(train_ds))))
+        t0 = time.perf_counter()
+        client.score(indices=ids)   # cold: compiles the request program
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        client.score(indices=ids)   # first warm request, measured solo
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        report = serve_client.load_generate(
+            url, rps=args.rps, duration_s=args.duration,
+            batch=min(args.request_batch, len(train_ds)),
+            max_index=len(train_ds) - 1, timeout_s=600.0)
+        if report["p95_ms"] is None:
+            raise RuntimeError(
+                f"serve load window completed no requests: {report}")
+        stats = service.stats_record()
+        emit(metric, round(report["p95_ms"], 3), "ms",
+             round(SERVE_BUDGET_P95_MS / report["p95_ms"], 4),
+             p50_ms=report["p50_ms"], max_ms=report["max_ms"],
+             cold_ms=round(cold_ms, 3), first_warm_ms=round(warm_ms, 3),
+             requests=report["sent"], ok=report["ok"],
+             rejected=report["rejected"], request_errors=report["errors"],
+             offered_rps=report["offered_rps"],
+             achieved_rps=report["achieved_rps"],
+             dispatches=stats["dispatches"], batch_fill=stats["batch_fill"],
+             serve_batch=engine.batch_size)
+    finally:
+        service.stop()
 
 
 def bench_train(args, metric: str) -> None:

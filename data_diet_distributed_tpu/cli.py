@@ -23,12 +23,17 @@ from .obs import (MetricsLogger, ResourceMonitor, plot_metrics,
 
 def _build(argv: list[str]) -> tuple[str, Config, argparse.Namespace]:
     parser = argparse.ArgumentParser(prog="data_diet_distributed_tpu")
-    parser.add_argument("command", choices=["run", "train", "score", "sweep"],
+    parser.add_argument("command",
+                        choices=["run", "train", "score", "sweep", "serve"],
                         help="run = score->prune->retrain end-to-end; "
                              "train = dense training only; "
                              "score = compute+save per-example scores only; "
                              "sweep = one scoring pass, then prune+retrain "
-                             "per prune.sweep sparsity level")
+                             "per prune.sweep sparsity level; "
+                             "serve = scoring-as-a-service: keep compiled "
+                             "score programs + dataset residents warm and "
+                             "answer /v1/score /v1/rank /v1/topk over HTTP "
+                             "until SIGTERM (drain, then exit 75)")
     parser.add_argument("--config", default=None, help="YAML config path")
     parser.add_argument("overrides", nargs="*", help="dotted.key=value overrides")
     # parse_intermixed_args, NOT parse_args: the documented invocation puts
@@ -324,21 +329,21 @@ def _dispatch(command: str, cfg: Config, logger: MetricsLogger) -> dict | None:
         # ONE derivation of the headline numbers (FitResult.throughput_
         # summary) — bench.py reads the same summary instead of re-deriving.
         return res.throughput_summary()
+    elif command == "serve":
+        from .serve.server import run_serve
+        return run_serve(cfg, logger)
     elif command == "score":
-        from .data.pipeline import BatchSharder
-        from .parallel.mesh import is_primary, run_mesh
-        from .train.loop import (compute_scores, load_data_for,
-                                 pipeline_stages, scores_npz_path)
+        from .parallel.mesh import is_primary
+        from .train.loop import (compute_scores, pipeline_context,
+                                 scores_npz_path)
         from .utils.io import atomic_savez
-        mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
-        sharder = BatchSharder(mesh)
-        train_ds, _ = load_data_for(cfg)
+        mesh, sharder, train_ds, _, stages = pipeline_context(cfg, logger)
         # Stage-resumable like `run`: per-seed partials under checkpoint_dir;
         # a preempted (75) score command re-invoked with the same config
         # recomputes only the incomplete seeds.
         scores, score_t = compute_scores(cfg, train_ds, mesh=mesh,
                                          sharder=sharder, logger=logger,
-                                         stages=pipeline_stages(cfg, logger))
+                                         stages=stages)
         out = scores_npz_path(cfg.train.checkpoint_dir)
         if is_primary():   # every process holds the full scores; one writes
             method = (f"reused:{score_t['loaded_from']}"

@@ -306,6 +306,59 @@ class ElasticConfig:
     resume_preempted: bool = True
 
 
+#: Checkpoint-based score methods the serving layer can hold warm
+#: (trajectory methods score a training run, not a checkpoint — they cannot
+#: answer a request). ONE definition: ``Config.validate`` and the serve
+#: engine's method dispatch both read it.
+SERVABLE_METHODS = ("el2n", "margin", "grand", "grand_vmap",
+                    "grand_last_layer")
+
+
+@dataclass
+class ServeConfig:
+    """Scoring-as-a-service (``serve/``): a long-lived process that keeps
+    compiled score programs and dataset residents warm on the mesh and
+    answers streaming HTTP requests — ``POST /v1/score`` (score a batch of
+    examples), ``POST /v1/rank`` (re-rank a slice), ``GET /v1/topk``
+    (top-k hardest, streamed), plus the obs stack's /healthz /metrics
+    /status. Booted by ``cli serve``; requests coalesce into chunked score
+    dispatches (``serve/batcher.py``) with admission control (429 +
+    Retry-After past ``max_queue``) and weighted round-robin fairness
+    across tenants. SIGTERM drains in-flight requests bounded by
+    ``drain_timeout_s`` and exits 75 (the preemption contract)."""
+
+    port: int = 0                    # 0 = auto-pick; logged as obs_server
+    host: str = "127.0.0.1"
+    # Default tenant name the CLI registers; None -> data.dataset.
+    tenant: str | None = None
+    # Methods warmed (compiled + resident-scored) at boot; () -> the
+    # configured score.method only. Requests may still name any registry
+    # method — unwarmed ones pay their compile on first use.
+    methods: tuple[str, ...] = ()
+    # Request-batch geometry (the compiled program's B); None ->
+    # score.batch_size. Requests pad to this tile (row-0 tail discipline).
+    batch_size: int | None = None
+    # Per-tenant pending-request cap: a submit past it is rejected with
+    # 429 + Retry-After (admission control, never an unbounded queue).
+    max_queue: int = 64
+    retry_after_s: float = 1.0       # the 429 Retry-After hint
+    # Deadline-bounded coalescing window: a partial batch dispatches at most
+    # this long after its oldest request arrived (a full batch never waits).
+    coalesce_ms: float = 5.0
+    # Per-request completion bound inside the service (queue + dispatch).
+    request_timeout_s: float = 60.0
+    # SIGTERM drain: stop admission, finish in-flight work, bounded.
+    drain_timeout_s: float = 30.0
+    # serve_stats record + serve-SLO evaluation cadence in the serve loop.
+    stats_every_s: float = 10.0
+    # Score the registered dataset for every serve.methods method at boot
+    # (warms the compiled programs AND the resident top-k/rank answers).
+    warm: bool = True
+    # Per-request {"kind": "serve_request"} records (tenant/method/n/walls).
+    # Disable for genuinely heavy traffic; serve_stats aggregates remain.
+    request_log: bool = True
+
+
 @dataclass
 class ResilienceConfig:
     """Fault-tolerance layer (``resilience/``): watchdog, preemption handling,
@@ -477,6 +530,16 @@ class ObsConfig:
     slo_nonfinite_frac: float | None = None
     # Eval-accuracy floor checked at each eval boundary.
     slo_eval_accuracy_floor: float | None = None
+    # Serving SLOs (serve/): evaluated at every serve_stats point while the
+    # service runs. p95 request latency budget in milliseconds (queue wait +
+    # dispatch, measured per request)...
+    slo_serve_p95_ms: float | None = None
+    # ...max tolerated pending-request depth across tenants at a stats
+    # point (queue-depth floor)...
+    slo_serve_queue_depth: int | None = None
+    # ...and the admission floor: max tolerated rejected fraction of all
+    # submitted requests (429s / accepted+rejected) over the run so far.
+    slo_serve_reject_frac: float | None = None
     # Cross-attempt recovery budget (seconds): time from the supervisor's
     # fault classification to the FIRST post-resume training step of the
     # relaunched attempt, computed from the lineage-stamped records in the
@@ -500,6 +563,7 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def validate(self) -> "Config":
         if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
@@ -676,6 +740,46 @@ class Config:
         if o.slo_recovery_s is not None and o.slo_recovery_s <= 0:
             raise ValueError(
                 f"obs.slo_recovery_s must be > 0, got {o.slo_recovery_s}")
+        if o.slo_serve_p95_ms is not None and o.slo_serve_p95_ms <= 0:
+            raise ValueError(
+                f"obs.slo_serve_p95_ms must be > 0, got {o.slo_serve_p95_ms}")
+        if o.slo_serve_queue_depth is not None and o.slo_serve_queue_depth < 1:
+            raise ValueError(
+                f"obs.slo_serve_queue_depth must be >= 1, got "
+                f"{o.slo_serve_queue_depth}")
+        if (o.slo_serve_reject_frac is not None
+                and not 0.0 <= o.slo_serve_reject_frac < 1.0):
+            raise ValueError(
+                f"obs.slo_serve_reject_frac must be in [0, 1), got "
+                f"{o.slo_serve_reject_frac}")
+        sv = self.serve
+        if not 0 <= sv.port <= 65535:
+            raise ValueError(
+                f"serve.port must be in [0, 65535] (0 = auto-pick), got "
+                f"{sv.port}")
+        for m in sv.methods:
+            if m not in SERVABLE_METHODS:
+                raise ValueError(
+                    f"serve.methods entries must be checkpoint-based score "
+                    f"methods (trajectory methods cannot serve a warm "
+                    f"checkpoint), got {m!r}")
+        if sv.batch_size is not None and sv.batch_size < 1:
+            raise ValueError(
+                f"serve.batch_size must be >= 1 (or null for "
+                f"score.batch_size), got {sv.batch_size}")
+        if sv.max_queue < 1:
+            raise ValueError(f"serve.max_queue must be >= 1, got "
+                             f"{sv.max_queue}")
+        if sv.coalesce_ms < 0:
+            raise ValueError(f"serve.coalesce_ms must be >= 0, got "
+                             f"{sv.coalesce_ms}")
+        if (sv.retry_after_s <= 0 or sv.request_timeout_s <= 0
+                or sv.drain_timeout_s <= 0 or sv.stats_every_s <= 0):
+            raise ValueError(
+                "serve timings need retry_after_s/request_timeout_s/"
+                "drain_timeout_s/stats_every_s > 0; got "
+                f"{sv.retry_after_s}/{sv.request_timeout_s}/"
+                f"{sv.drain_timeout_s}/{sv.stats_every_s}")
         return self
 
 
@@ -702,7 +806,7 @@ _TYPE_MAP = {
     "MeshConfig": MeshConfig, "OverlapConfig": OverlapConfig,
     "ParallelConfig": ParallelConfig, "CheckpointConfig": CheckpointConfig,
     "ObsConfig": ObsConfig, "ResilienceConfig": ResilienceConfig,
-    "ElasticConfig": ElasticConfig,
+    "ElasticConfig": ElasticConfig, "ServeConfig": ServeConfig,
 }
 
 

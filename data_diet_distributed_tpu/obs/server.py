@@ -82,44 +82,58 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         try:
             path = self.path.split("?", 1)[0].rstrip("/") or "/"
-            if path == "/healthz":
-                health = owner.health()
-                code = 503 if health["status"] == "critical" else 200
-                body, ctype = json.dumps(health).encode(), "application/json"
-            elif path == "/metrics":
-                text = owner.prometheus()
-                code = 200 if text is not None else 503
-                body = (text if text is not None
-                        else "# no metrics registry installed\n").encode()
-                ctype = "text/plain; version=0.0.4"
-            elif path == "/status":
-                body = json.dumps(owner.status()).encode()
-                code, ctype = 200, "application/json"
-            elif path == "/flightrec":
-                body = json.dumps(owner.flightrec()).encode()
-                code, ctype = 200, "application/json"
-            else:
-                body = json.dumps({"error": f"unknown path {path!r}",
-                                   "endpoints": ["/healthz", "/metrics",
-                                                 "/status", "/flightrec"]}
-                                  ).encode()
-                code, ctype = 404, "application/json"
+            code, body, ctype = self._get_payload(owner, path)
         except Exception as exc:   # noqa: BLE001 — a probe failure is a payload,
             body = json.dumps({"error": repr(exc)[:300]}).encode()   # not a crash
             code, ctype = 500, "application/json"
+        self._respond(code, body, ctype)
+        owner._note_request(time.perf_counter() - t0)
+
+    def _get_payload(self, owner: "StatusServer",
+                     path: str) -> tuple[int, bytes, str]:
+        """GET dispatch as data, so a subclass (the serving layer's
+        ``serve/server.py``) can extend the path table and fall back here."""
+        if path == "/healthz":
+            health = owner.health()
+            code = 503 if health["status"] == "critical" else 200
+            return code, json.dumps(health).encode(), "application/json"
+        if path == "/metrics":
+            text = owner.prometheus()
+            code = 200 if text is not None else 503
+            body = (text if text is not None
+                    else "# no metrics registry installed\n").encode()
+            return code, body, "text/plain; version=0.0.4"
+        if path == "/status":
+            return (200, json.dumps(owner.status()).encode(),
+                    "application/json")
+        if path == "/flightrec":
+            return (200, json.dumps(owner.flightrec()).encode(),
+                    "application/json")
+        body = json.dumps({"error": f"unknown path {path!r}",
+                           "endpoints": owner.endpoint_names()}).encode()
+        return 404, body, "application/json"
+
+    def _respond(self, code: int, body: bytes, ctype: str,
+                 extra_headers: dict | None = None) -> None:
         try:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
         except OSError:
             pass   # client went away mid-write: their problem, not the run's
-        owner._note_request(time.perf_counter() - t0)
 
 
 class StatusServer:
     """Threaded HTTP endpoint over the installed obs instruments."""
+
+    #: The request-handler class ``start`` binds — subclasses (the serving
+    #: layer's ``ServeServer``) override it to add endpoints while reusing
+    #: this chassis's lifecycle/degrade contract unchanged.
+    handler_class: type = _Handler
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
                  stale_after_s: float | None = None, logger=None):
@@ -145,7 +159,7 @@ class StatusServer:
         (never crashes the run — the port-collision contract)."""
         try:
             httpd = ThreadingHTTPServer((self.host, self.requested_port),
-                                        _Handler)
+                                        self.handler_class)
         except OSError as exc:
             print(f"[obs] status server: bind {self.host}:"
                   f"{self.requested_port} failed ({exc}); live endpoints "
@@ -160,7 +174,7 @@ class StatusServer:
         self._thread.start()
         print(f"[obs] status server listening on "
               f"http://{self.host}:{self.port} "
-              "(/healthz /metrics /status /flightrec)", flush=True)
+              f"({' '.join(self.endpoint_names())})", flush=True)
         if self.logger is not None:
             try:
                 self.logger.log("obs_server", event="started", host=self.host,
@@ -190,6 +204,11 @@ class StatusServer:
         with self._lock:
             return {"port": self.port, "requests": self._requests,
                     "handle_s": round(self._handle_s, 4)}
+
+    def endpoint_names(self) -> list[str]:
+        """The served paths (the 404 payload's hint + the startup banner);
+        subclasses extend."""
+        return ["/healthz", "/metrics", "/status", "/flightrec"]
 
     # ------------------------------------------------- training-loop inputs
 

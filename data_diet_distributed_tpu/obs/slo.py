@@ -119,6 +119,9 @@ class SloEngine:
                  nonfinite_frac: float | None = None,
                  eval_accuracy_floor: float | None = None,
                  recovery_s: float | None = None,
+                 serve_p95_ms: float | None = None,
+                 serve_queue_depth: int | None = None,
+                 serve_reject_frac: float | None = None,
                  baseline_window: int = DEFAULT_BASELINE_WINDOW,
                  geometry: dict | None = None, logger=None):
         self.throughput_floor = throughput_floor
@@ -138,6 +141,12 @@ class SloEngine:
         self.heartbeat_stale_s = heartbeat_stale_s
         self.nonfinite_frac = nonfinite_frac
         self.eval_accuracy_floor = eval_accuracy_floor
+        # Serving contract (serve/): p95 request-latency budget, pending
+        # queue-depth ceiling, and the admission floor (tolerated rejected
+        # fraction) — evaluated at every serve_stats point.
+        self.serve_p95_ms = serve_p95_ms
+        self.serve_queue_depth = serve_queue_depth
+        self.serve_reject_frac = serve_reject_frac
         self.baseline_window = baseline_window
         self.logger = logger
         self.violations: list[dict] = []   # bounded retention (MAX_RETAINED)
@@ -153,9 +162,15 @@ class SloEngine:
         """None when the config declares no objective — the engine is pure
         opt-in, like every obs instrument."""
         o = cfg.obs
-        if not any((o.slo_throughput_floor, o.slo_throughput_frac,
-                    o.slo_heartbeat_stale_s, o.slo_nonfinite_frac,
-                    o.slo_eval_accuracy_floor, o.slo_recovery_s)):
+        # is-not-None, not truthiness: slo_serve_reject_frac=0.0 (zero
+        # tolerated rejections — the strictest valid setting) and
+        # slo_nonfinite_frac=0.0 must still install the engine.
+        if all(v is None for v in (
+                o.slo_throughput_floor, o.slo_throughput_frac,
+                o.slo_heartbeat_stale_s, o.slo_nonfinite_frac,
+                o.slo_eval_accuracy_floor, o.slo_recovery_s,
+                o.slo_serve_p95_ms, o.slo_serve_queue_depth,
+                o.slo_serve_reject_frac)):
             return None
         # The SAME geometry block cli._append_perf_ledger writes: the
         # baseline this run is held to is the trail of runs of its own shape.
@@ -170,6 +185,9 @@ class SloEngine:
                    nonfinite_frac=o.slo_nonfinite_frac,
                    eval_accuracy_floor=o.slo_eval_accuracy_floor,
                    recovery_s=o.slo_recovery_s,
+                   serve_p95_ms=o.slo_serve_p95_ms,
+                   serve_queue_depth=o.slo_serve_queue_depth,
+                   serve_reject_frac=o.slo_serve_reject_frac,
                    logger=logger)
 
     # ----------------------------------------------------------- plumbing
@@ -179,7 +197,8 @@ class SloEngine:
         examples) — resolved throughput floor included once known."""
         out = {k: getattr(self, k) for k in
                ("throughput_floor", "throughput_frac", "heartbeat_stale_s",
-                "nonfinite_frac", "eval_accuracy_floor", "recovery_s")
+                "nonfinite_frac", "eval_accuracy_floor", "recovery_s",
+                "serve_p95_ms", "serve_queue_depth", "serve_reject_frac")
                if getattr(self, k) is not None}
         if self._baseline_resolved:
             out["throughput_baseline"] = self._baseline
@@ -356,6 +375,32 @@ class SloEngine:
                           attempt=self._recovery_attempt)
         self._mark_ok()
 
+    def check_serve(self, *, point, p95_ms: float | None = None,
+                    queue_depth: int | None = None,
+                    reject_frac: float | None = None, logger=None) -> None:
+        """Serving-contract evaluation, once per serve_stats point: p95
+        request latency vs ``slo_serve_p95_ms``, pending queue depth vs
+        ``slo_serve_queue_depth``, and the run-so-far rejected fraction vs
+        ``slo_serve_reject_frac``. ``point`` is the stats sequence number —
+        a sustained breach re-records at each new point (a sustained
+        collapse is a sustained fact), never twice for the same one."""
+        if (self.serve_p95_ms is not None and p95_ms is not None
+                and p95_ms > self.serve_p95_ms):
+            self._violate("serve_p95", round(float(p95_ms), 3),
+                          self.serve_p95_ms, logger=logger,
+                          point=("serve_p95", point))
+        if (self.serve_queue_depth is not None and queue_depth is not None
+                and queue_depth > self.serve_queue_depth):
+            self._violate("serve_queue_depth", int(queue_depth),
+                          self.serve_queue_depth, logger=logger,
+                          point=("serve_queue", point))
+        if (self.serve_reject_frac is not None and reject_frac is not None
+                and reject_frac > self.serve_reject_frac):
+            self._violate("serve_admission", round(float(reject_frac), 6),
+                          self.serve_reject_frac, logger=logger,
+                          point=("serve_admission", point))
+        self._mark_ok()
+
     def check_scores(self, method: str, scores, *, logger=None) -> None:
         """Scoring-pass evaluation: the nonfinite-score budget over the
         final score vector (a scoring pass whose output is part-NaN is a
@@ -403,6 +448,13 @@ def check_epoch(**kwargs) -> None:
 def check_scores(method: str, scores, *, logger=None) -> None:
     if _ENGINE is not None:
         _ENGINE.check_scores(method, scores, logger=logger)
+
+
+def check_serve(**kwargs) -> None:
+    """Library-code entry (the serve loop's stats points): no-op until an
+    engine with serve objectives is installed."""
+    if _ENGINE is not None:
+        _ENGINE.check_serve(**kwargs)
 
 
 def arm_recovery(metrics_path: str | None) -> None:

@@ -405,4 +405,10 @@ def make_score_chunk(model, method: str, mesh: Mesh | None = None,
                             images.shape[0] * images.shape[1])
         return jitted(variables, images, labels, mask, **kwargs)
 
+    # The underlying jitted function, exposed for AOT warming: the serving
+    # engine's compiled-program cache calls ``dispatch.jitted.lower(...)
+    # .compile()`` on a cache miss — jax's compilation cache is shared with
+    # the dispatch path (pinned by PR-6's probe measurements), so the first
+    # real dispatch after a warm never recompiles.
+    dispatch.jitted = jitted
     return dispatch

@@ -340,9 +340,15 @@ class ScoreResident:
         labels[:self.n] = dense.labels
         mask = np.zeros(self.nb * batch_size, np.float32)
         mask[:self.n] = 1.0
+        # The block layout (leading batch-index dim unsharded, batch dim over
+        # the flat mesh) — kept public so the serving engine can place its
+        # per-request [1, B, ...] blocks EXACTLY like the resident blocks.
+        self.sharding = None
         if mesh is not None and mesh.size > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            sharding = NamedSharding(mesh, P(None, tuple(mesh.axis_names)))
+            self.sharding = NamedSharding(mesh,
+                                          P(None, tuple(mesh.axis_names)))
+            sharding = self.sharding
 
             def put(a):
                 return jax.device_put(a, sharding)
@@ -366,6 +372,23 @@ class ScoreResident:
                 yield self.images[s:e], self.labels[s:e], self.mask[s:e]
 
 
+def score_resident_pass(chunk_fn, resident: "ScoreResident", variables,
+                        k_chunk: int) -> np.ndarray:
+    """ONE seed's whole scoring pass over a prebuilt ``ScoreResident``:
+    ``ceil(nb / K)`` chunked dispatches and ONE fetch of the stacked score
+    blocks — the epoch's entire device→host traffic. Returns the float64
+    ``[n]`` seed vector (float64 exactly represents every float32, so a
+    resumed-partial mean stays bit-identical). The one definition shared by
+    ``_score_dataset_chunked`` and the serving engine's warm resident path
+    (``serve/engine.py``), so the two cannot drift."""
+    outs = [_dispatch_score_chunk(chunk_fn, variables, *blk)
+            for blk in resident.blocks(k_chunk)]
+    with obs_registry.timed("score_fetch_s"):
+        return np.concatenate(
+            [np.asarray(o, np.float64) for o in jax.device_get(outs)],
+            axis=0).reshape(-1)[:resident.n]
+
+
 def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
                            *, method: str, batch_size: int,
                            sharder: BatchSharder | None, chunk: int,
@@ -385,15 +408,8 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
                                 use_pallas=use_pallas)
     total = np.zeros(resident.n, np.float64)
     for k, variables in enumerate(variables_seeds):
-        outs = [_dispatch_score_chunk(chunk_fn, variables, *blk)
-                for blk in resident.blocks(k_chunk)]
-        # ONE fetch per seed — the score blocks' round trip is the epoch's
-        # entire device→host traffic (float64 exactly represents every
-        # float32, so the resumed-partial mean stays bit-identical).
-        with obs_registry.timed("score_fetch_s"):
-            seed_scores = np.concatenate(
-                [np.asarray(o, np.float64) for o in jax.device_get(outs)],
-                axis=0).reshape(-1)[:resident.n]
+        seed_scores = score_resident_pass(chunk_fn, resident, variables,
+                                          k_chunk)
         total += seed_scores
         obs_scoreboard.note_seed_scores(
             method, seed_ids[k] if seed_ids is not None else k, seed_scores)

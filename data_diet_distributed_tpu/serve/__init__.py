@@ -1,0 +1,28 @@
+"""Scoring-as-a-service: the persistent serving layer for data valuation.
+
+The batch pipeline computes scores and dies with the job; this package keeps
+them ALIVE — a long-lived process holding compiled score programs and
+dataset residents warm on the mesh, answering streaming HTTP requests:
+"score these examples under model M", "re-rank this slice", "top-k hardest".
+
+Four layers:
+
+* ``engine.py``  — the warm-callable engine API (``fit`` / ``score`` /
+  ``evaluate`` as composable units over one shared mesh + residents) with a
+  compiled-program cache keyed by ``(arch, geometry, method)`` riding
+  ``lower().compile()``;
+* ``batcher.py`` — request batching/coalescing into chunked score
+  dispatches, with admission control, bounded queues, backpressure, and
+  weighted round-robin multi-tenant fairness;
+* ``server.py``  — the HTTP surface on the obs StatusServer chassis
+  (``POST /v1/score``, ``POST /v1/rank``, ``GET /v1/topk`` streamed, plus
+  /healthz /metrics /status from the existing obs stack) and the
+  ``cli serve`` entry with graceful SIGTERM drain (exit 75);
+* the SLO engine (``obs/slo.py``) as the service contract
+  (``slo_serve_p95_ms``, queue-depth and admission floors) feeding
+  /healthz and ``run_monitor --once``.
+"""
+
+from .batcher import Backpressure, Draining, ScoreBatcher  # noqa: F401
+from .engine import ServeEngine  # noqa: F401
+from .server import ServeServer, ServeService, run_serve  # noqa: F401

@@ -1,0 +1,340 @@
+"""The warm-callable engine: compiled score programs + dataset residents.
+
+This is the engine-API split of ``train/loop.py``'s monolithic stage driver:
+``fit``, ``score``, and ``evaluate`` become composable, warm-callable units
+over ONE shared mesh/sharder pair, instead of each pipeline command
+re-deriving its own. The serving layer is the first consumer; later work
+(online re-scoring schedules, diet-squared experiments) composes the same
+units.
+
+What stays warm between calls, per registered TENANT (a named dataset +
+scoring model):
+
+* the dense float32 dataset rows (request batches assemble from them with
+  the exact ``ScoreResident`` composition — row-0 tail images, zeroed tail
+  labels, mask 0 — so a padded request scores bit-identical to the offline
+  engines);
+* a ``ScoreResident`` upload (pre-batched, pre-sharded blocks) built once
+  and reused by every whole-dataset pass (top-k / rank answers);
+* the resident score vectors per method, computed once through the shared
+  ``score_resident_pass`` — the same code path ``score_dataset``'s chunked
+  engine runs, so served answers cannot drift from offline ones;
+* the compiled-program cache: keyed ``(arch, geometry, method)``, warmed
+  via the jitted score chunk's ``lower().compile()`` (jax's compilation
+  cache is shared with the dispatch path — PR-6 pinned it — so the first
+  real dispatch after a warm never recompiles), with a strong reference to
+  the compiled executable so the weakref'd jit cache cannot evict it.
+
+Thread model: every device dispatch is serialized behind ``_lock`` (the
+batcher's worker owns the hot path; handler threads answering top-k/rank
+contend only on a cold first pass).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from ..config import SERVABLE_METHODS, Config
+from ..data.datasets import ArrayDataset, make_position_joiner
+from ..data.pipeline import BatchSharder
+from ..models import create_model_from_cfg
+from ..obs import registry as obs_registry
+from ..ops.scores import make_score_chunk
+from ..ops.scoring import (MAX_SCORE_CHUNK_STEPS, ScoreResident,
+                           score_resident_pass)
+from ..parallel.mesh import replicate, run_mesh
+
+# SERVABLE_METHODS lives in config (the one definition — Config.validate
+# checks serve.methods against the same tuple the engine dispatches on) and
+# is re-exported here for the serving layer's callers.
+
+
+@dataclass
+class Tenant:
+    """One named dataset + scoring model resident on the mesh."""
+
+    name: str
+    ds: ArrayDataset
+    variables_seeds: list
+    weight: int = 1
+    images: np.ndarray | None = None     # dense float32 rows, host
+    labels: np.ndarray | None = None
+    pos_of: Any = None                   # global id -> row position joiner
+    resident: ScoreResident | None = None
+    scores: dict[str, np.ndarray] = field(default_factory=dict)
+
+
+class ServeEngine:
+    """Warm-callable ``fit`` / ``score`` / ``evaluate`` units over one mesh.
+
+    ``cfg`` supplies the model recipe and scoring knobs; tenants are
+    registered with their own dataset (and optionally their own scoring
+    variables — the CLI builds them from the config's pretrain recipe).
+    """
+
+    def __init__(self, cfg: Config, *, mesh=None, logger=None):
+        self.cfg = cfg
+        self.logger = logger
+        self.mesh = mesh if mesh is not None else run_mesh(
+            cfg.mesh, elastic=cfg.elastic.enabled)
+        # Training layout vs scoring layout: fit shards over the data axis,
+        # scoring flattens the whole mesh (ops/scores._wrap) — hold both.
+        self.train_sharder = BatchSharder(self.mesh)
+        self.sharder = BatchSharder.flat(self.mesh)
+        self.batch_size = self.sharder.global_batch_size_for(
+            cfg.serve.batch_size or cfg.score.batch_size)
+        self.model = create_model_from_cfg(cfg)
+        self.tenants: dict[str, Tenant] = {}
+        self._multi = self.mesh.size > 1
+        # Compiled-program cache: (arch, geometry, method) -> entry holding
+        # the AOT-compiled executable (strong ref) + serving stats.
+        self._programs: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ composable units
+
+    def fit(self, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None,
+            **kwargs):
+        """The training unit: ``train/loop.fit`` over the engine's shared
+        mesh/sharder (a warm caller never re-derives either)."""
+        from ..train.loop import fit
+        return fit(self.cfg, train_ds, test_ds, mesh=self.mesh,
+                   sharder=self.train_sharder, logger=self.logger, **kwargs)
+
+    def evaluate(self, state, ds: ArrayDataset, batch_size: int | None = None):
+        """The eval unit: ``train/loop.evaluate`` on the shared sharder."""
+        from ..train.loop import evaluate
+        return evaluate(self.model, state, ds, self.train_sharder,
+                        batch_size or self.cfg.data.eval_batch_size)
+
+    def scoring_variables(self, ds: ArrayDataset,
+                          seeds: Sequence[int] | None = None) -> list:
+        """The scoring-model unit: per-seed variable pytrees from the
+        config's recipe (pretrain / fixed checkpoint / init-at-seed),
+        sharing one dataset upload across seeds."""
+        from ..obs import MetricsLogger
+        from ..train.loop import score_variables_for_seeds
+        return score_variables_for_seeds(
+            self.cfg, ds, mesh=self.mesh, sharder=self.train_sharder,
+            logger=self.logger or MetricsLogger(None, echo=False),
+            seeds=seeds)
+
+    def score(self, tenant: str, method: str | None = None) -> np.ndarray:
+        """The scoring unit: the tenant's full resident score vector (alias
+        of ``full_scores`` — the engine-API name)."""
+        return self.full_scores(tenant, method or self.cfg.score.method)
+
+    # ------------------------------------------------------------- tenants
+
+    def register_tenant(self, name: str, ds: ArrayDataset,
+                        variables_seeds: Sequence | None = None, *,
+                        weight: int = 1) -> Tenant:
+        """Make a dataset + scoring model resident under ``name``.
+
+        ``variables_seeds`` None builds them from the config recipe
+        (pretrain epochs / fixed checkpoint / init). TP-sharded variables
+        are re-replicated ONCE, like ``score_dataset`` does per pass."""
+        if weight < 1:
+            raise ValueError(f"tenant weight must be >= 1, got {weight}")
+        if variables_seeds is None:
+            variables_seeds = self.scoring_variables(ds)
+        elif self._multi:
+            variables_seeds = [replicate(v, self.mesh)
+                               for v in variables_seeds]
+        dense = ds.dense()
+        tenant = Tenant(name=name, ds=ds,
+                        variables_seeds=list(variables_seeds), weight=weight,
+                        images=np.asarray(dense.images, np.float32),
+                        labels=np.asarray(dense.labels, np.int32),
+                        pos_of=make_position_joiner(ds.indices))
+        with self._lock:
+            self.tenants[name] = tenant
+        return tenant
+
+    def tenant(self, name: str) -> Tenant:
+        try:
+            return self.tenants[name]
+        except KeyError:
+            raise KeyError(f"unknown tenant {name!r}; registered: "
+                           f"{sorted(self.tenants)}") from None
+
+    def tenant_weight(self, name: str) -> int:
+        """The batcher's fairness weight lookup (1 for unknown names — the
+        batcher may see a submit racing a registration teardown)."""
+        t = self.tenants.get(name)
+        return t.weight if t is not None else 1
+
+    def examples_for(self, tenant: str, ids) -> tuple[np.ndarray, np.ndarray]:
+        """Dense float32 rows + labels for global example ids (KeyError for
+        ids not in the tenant's dataset — the 400 path)."""
+        t = self.tenant(tenant)
+        pos = t.pos_of(np.asarray(ids, np.int64))
+        return t.images[pos], t.labels[pos]
+
+    # ------------------------------------------------------ compiled programs
+
+    def _check_method(self, method: str) -> str:
+        if method not in SERVABLE_METHODS:
+            raise ValueError(f"unservable score method {method!r} "
+                             f"(servable: {', '.join(SERVABLE_METHODS)})")
+        return method
+
+    def _chunk_fn(self, method: str):
+        cfg = self.cfg
+        return make_score_chunk(self.model, method,
+                                self.mesh if self._multi else None,
+                                chunk=cfg.score.grand_chunk,
+                                eval_mode=cfg.score.eval_mode,
+                                use_pallas=cfg.score.use_pallas)
+
+    def _ensure_program(self, method: str, chunk_fn, operands) -> dict:
+        """The compiled-program cache entry for this request geometry,
+        compiling on miss via the jitted chunk's ``lower().compile()``.
+        Must be called with ``_lock`` held."""
+        # Full image geometry, not just (K, B): two tenants with different
+        # image dims under one arch are DIFFERENT programs — a [:2] key
+        # would skip the second tenant's warm and misattribute its stats.
+        key = (self.cfg.model.arch, tuple(operands[1].shape), method)
+        entry = self._programs.get(key)
+        if entry is None:
+            t0 = time.perf_counter()
+            compiled = chunk_fn.jitted.lower(*operands).compile()
+            compile_s = time.perf_counter() - t0
+            entry = self._programs[key] = {
+                "compiled": compiled,   # strong ref: jit's cache is weak
+                "compiles": 1, "dispatches": 0,
+                "compile_s": round(compile_s, 4),
+            }
+            obs_registry.observe("serve_compile_s", compile_s)
+        return entry
+
+    def program_stats(self) -> dict[str, dict]:
+        """The cache as data for /status and serve_stats: one row per
+        (arch, geometry, method) key, executables elided."""
+        with self._lock:
+            return {f"{a}:{g}:{m}": {k: v for k, v in e.items()
+                                     if k != "compiled"}
+                    for (a, g, m), e in self._programs.items()}
+
+    # ------------------------------------------------------------ scoring
+
+    def _placed_block(self, tenant: Tenant, images: np.ndarray,
+                      labels: np.ndarray) -> tuple:
+        """One padded ``[1, B, ...]`` operand triple with the resident block
+        layout. Padding follows the ``ScoreResident`` tail discipline to the
+        letter: row-0 images, zeroed labels, mask 0."""
+        n, b = len(images), self.batch_size
+        if n > b:
+            raise ValueError(f"request batch {n} exceeds the compiled "
+                             f"geometry B={b} (the batcher splits)")
+        imgs = np.empty((b, *tenant.images.shape[1:]), np.float32)
+        imgs[:n] = images
+        imgs[n:] = tenant.images[0]
+        labs = np.zeros(b, np.int32)
+        labs[:n] = labels
+        mask = np.zeros(b, np.float32)
+        mask[:n] = 1.0
+        sharding = tenant.resident.sharding if tenant.resident is not None \
+            else self._request_sharding()
+        ops = (imgs[None], labs[None], mask[None])
+        if sharding is not None:
+            ops = tuple(jax.device_put(o, sharding) for o in ops)
+        return ops
+
+    def _request_sharding(self):
+        if not self._multi:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(None, tuple(self.mesh.axis_names)))
+
+    def score_batch(self, tenant: str, method: str, images: np.ndarray,
+                    labels: np.ndarray) -> np.ndarray:
+        """Score ``n <= B`` examples through the warm compiled program.
+
+        Returns ``scores[n]`` float32, bit-identical to the offline engines
+        for the same examples: same score math (``make_local_scores`` via
+        ``make_score_chunk``), same batch layout, same ``f64-mean -> f32``
+        seed reduction."""
+        self._check_method(method)
+        t = self.tenant(tenant)
+        n = len(images)
+        with self._lock:
+            chunk_fn = self._chunk_fn(method)
+            ops = self._placed_block(t, np.asarray(images, np.float32),
+                                     np.asarray(labels, np.int32))
+            entry = self._ensure_program(method, chunk_fn,
+                                         (t.variables_seeds[0], *ops))
+            total = np.zeros(n, np.float64)
+            t0 = time.perf_counter()
+            for variables in t.variables_seeds:
+                out = chunk_fn(variables, *ops)
+                total += np.asarray(jax.device_get(out), np.float64)[0, :n]
+            entry["dispatches"] += len(t.variables_seeds)
+            obs_registry.observe("serve_dispatch_s",
+                                 time.perf_counter() - t0)
+        return (total / len(t.variables_seeds)).astype(np.float32)
+
+    def full_scores(self, tenant: str, method: str) -> np.ndarray:
+        """The tenant's whole-dataset score vector (cached), computed over
+        the warm ``ScoreResident`` through ``score_resident_pass`` — the
+        exact chunked-engine code path, so top-k/rank answers bit-match an
+        offline ``score_dataset`` run of the same recipe."""
+        self._check_method(method)
+        t = self.tenant(tenant)
+        cached = t.scores.get(method)
+        if cached is not None:
+            return cached
+        with self._lock:
+            cached = t.scores.get(method)   # double-checked under the lock
+            if cached is not None:
+                return cached
+            if t.resident is None:
+                t.resident = ScoreResident(
+                    t.ds, self.batch_size,
+                    self.mesh if self._multi else None)
+            chunk_fn = self._chunk_fn(method)
+            k_chunk = max(1, min(t.resident.nb, MAX_SCORE_CHUNK_STEPS))
+            for blk in t.resident.blocks(k_chunk):
+                self._ensure_program(method, chunk_fn,
+                                     (t.variables_seeds[0], *blk))
+                break   # blocks share one geometry except a short tail
+            total = np.zeros(t.resident.n, np.float64)
+            t0 = time.perf_counter()
+            for variables in t.variables_seeds:
+                total += score_resident_pass(chunk_fn, t.resident, variables,
+                                             k_chunk)
+            obs_registry.observe("serve_dispatch_s", time.perf_counter() - t0)
+            scores = (total / len(t.variables_seeds)).astype(np.float32)
+            t.scores[method] = scores
+        return scores
+
+    # ----------------------------------------------------- ranked answers
+
+    def topk(self, tenant: str, method: str, k: int):
+        """Top-``k`` hardest (index, score) pairs from the resident scores,
+        as an ITERATOR — the transport can stream it without a [N]-sized
+        body ever existing. Ties break by global index, the same lexsort
+        discipline as pruning's ``select_indices``."""
+        scores = self.full_scores(tenant, method)
+        t = self.tenant(tenant)
+        k = max(0, min(int(k), len(scores)))
+        order = np.lexsort((t.ds.indices, -scores))[:k]
+        for pos in order:
+            yield int(t.ds.indices[pos]), float(scores[pos])
+
+    def rank(self, tenant: str, method: str,
+             ids) -> tuple[np.ndarray, np.ndarray]:
+        """Re-rank a slice hardest-first: ``(sorted_ids, sorted_scores)``
+        for the requested global ids (pruning's tie-break)."""
+        scores = self.full_scores(tenant, method)
+        t = self.tenant(tenant)
+        ids = np.asarray(ids, np.int64)
+        s = scores[t.pos_of(ids)]
+        order = np.lexsort((ids, -s))
+        return ids[order], s[order]

@@ -1,0 +1,400 @@
+"""The serving HTTP surface + service lifecycle, on the obs server chassis.
+
+``ServeServer`` extends ``obs/server.StatusServer`` (same ThreadingHTTPServer
+daemon-thread chassis, same bind-failure degrade contract) with the scoring
+endpoints:
+
+* ``POST /v1/score`` — score a batch of examples under a named tenant/method
+  (``{"indices": [...]}`` for registered-dataset examples, or
+  ``{"images": [...], "labels": [...]}`` for new ones); requests coalesce
+  through the batcher into warm chunked dispatches. 429 + Retry-After past
+  the admission bound, 503 while draining, 504 past the request budget.
+* ``POST /v1/rank`` — re-rank a slice hardest-first from resident scores.
+* ``GET /v1/topk?tenant=&method=&k=`` — top-k hardest, streamed as
+  newline-delimited JSON so a ``[N]``-sized response body never exists.
+* everything the obs chassis already serves — ``/healthz`` ``/metrics``
+  ``/status`` ``/flightrec`` — with a ``serve`` block added to ``/status``.
+
+``ServeService`` owns the engine + batcher + server trio, the serve_stats /
+serve-SLO cadence, and the graceful-drain contract: SIGTERM (via the shared
+``resilience/preemption`` handler) stops admission, drains in-flight
+requests bounded by ``serve.drain_timeout_s``, and raises ``Preempted`` —
+the CLI maps it to exit 75 like every preempted run. ``run_serve`` is the
+``cli serve`` entry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from urllib.parse import parse_qs, urlsplit
+
+import numpy as np
+
+from ..config import Config
+from ..obs import heartbeat as obs_heartbeat
+from ..obs import registry as obs_registry
+from ..obs import server as obs_server
+from ..obs import slo as obs_slo
+from ..resilience.preemption import Preempted, PreemptionHandler
+from .batcher import Backpressure, Draining, ScoreBatcher
+from .engine import SERVABLE_METHODS, ServeEngine
+
+
+def default_methods(cfg: Config) -> tuple[str, ...]:
+    """The methods the service warms at boot: ``serve.methods`` when set,
+    else the configured ``score.method`` (falling back to el2n when that is
+    a trajectory method, which cannot serve a warm checkpoint)."""
+    if cfg.serve.methods:
+        return tuple(cfg.serve.methods)
+    if cfg.score.method in SERVABLE_METHODS:
+        return (cfg.score.method,)
+    return ("el2n",)
+
+
+class _ServeHandler(obs_server._Handler):
+    server_version = "ddt-serve/1"
+
+    def do_GET(self):   # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/v1/topk":
+            owner = self.server.owner   # type: ignore[attr-defined]
+            t0 = time.perf_counter()
+            try:
+                self._stream_topk(owner)
+            except Exception as exc:   # noqa: BLE001 — never into the socket
+                self._respond(500, json.dumps(
+                    {"error": repr(exc)[:300]}).encode(), "application/json")
+            owner._note_request(time.perf_counter() - t0)
+            return
+        super().do_GET()
+
+    def do_POST(self):   # noqa: N802 — http.server API
+        owner = self.server.owner   # type: ignore[attr-defined]
+        t0 = time.perf_counter()
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+        except (ValueError, OSError) as exc:
+            self._respond(400, json.dumps(
+                {"error": f"bad request body: {exc}"[:300]}).encode(),
+                "application/json")
+            owner._note_request(time.perf_counter() - t0)
+            return
+        try:
+            service = owner.service
+            with service.http_inflight():
+                if path == "/v1/score":
+                    code, payload, headers = service.handle_score(body)
+                elif path == "/v1/rank":
+                    code, payload, headers = service.handle_rank(body)
+                else:
+                    code, headers = 404, {}
+                    payload = {"error": f"unknown path {path!r}",
+                               "endpoints": owner.endpoint_names()}
+        except Exception as exc:   # noqa: BLE001 — a failure is a payload
+            code, payload, headers = 500, {"error": repr(exc)[:300]}, {}
+        self._respond(code, json.dumps(payload).encode(), "application/json",
+                      headers)
+        owner._note_request(time.perf_counter() - t0)
+
+    def _stream_topk(self, owner) -> None:
+        service = owner.service
+        qs = parse_qs(urlsplit(self.path).query)
+
+        def q(name, default=None):
+            vals = qs.get(name)
+            return vals[0] if vals else default
+
+        try:
+            k = int(q("k", "10"))
+            # Resolve the scores BEFORE the status line: an unknown
+            # tenant/method must be a 400, not a torn 200 stream.
+            tenant, method, items = service.topk_prepare(
+                q("tenant"), q("method"), k)
+        except (KeyError, ValueError) as exc:
+            self._respond(400, json.dumps(
+                {"error": str(exc)[:300]}).encode(), "application/json")
+            return
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("X-Serve-Tenant", tenant)
+            self.send_header("X-Serve-Method", method)
+            # Body-until-close framing: the item count is not known to be
+            # small, and buffering it whole would defeat the streaming
+            # contract ([N] never materializes as one response body).
+            self.send_header("Connection", "close")
+            self.end_headers()
+            for index, score in items:
+                self.wfile.write(json.dumps(
+                    {"index": index, "score": score}).encode() + b"\n")
+        except OSError:
+            pass   # client went away mid-stream
+        self.close_connection = True
+
+
+class ServeServer(obs_server.StatusServer):
+    """The obs StatusServer chassis + the /v1 scoring endpoints."""
+
+    handler_class = _ServeHandler
+
+    def __init__(self, service: "ServeService", **kwargs):
+        super().__init__(**kwargs)
+        self.service = service
+
+    def endpoint_names(self) -> list[str]:
+        return super().endpoint_names() + ["/v1/score", "/v1/rank",
+                                           "/v1/topk"]
+
+    def status(self) -> dict:
+        out = super().status()
+        out["serve"] = self.service.stats_record()
+        return out
+
+
+class ServeService:
+    """Engine + batcher + server, with the stats/SLO cadence and the
+    graceful-drain lifecycle."""
+
+    def __init__(self, engine: ServeEngine, cfg: Config, logger=None):
+        self.engine = engine
+        self.cfg = cfg
+        self.logger = logger
+        sv = cfg.serve
+        self.default_tenant = sv.tenant or cfg.data.dataset
+        self.default_method = default_methods(cfg)[0]
+        self.batcher = ScoreBatcher(
+            engine, max_queue=sv.max_queue,
+            coalesce_window_s=sv.coalesce_ms / 1e3,
+            retry_after_s=sv.retry_after_s, request_log=sv.request_log,
+            logger=logger)
+        self.server = ServeServer(
+            self, port=sv.port, host=sv.host,
+            stale_after_s=cfg.obs.slo_heartbeat_stale_s, logger=logger)
+        self._installed = False
+        self._draining = False
+        self._http_inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._stats_seq = 0
+        self._started_ts = time.time()
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int | None:
+        return self.server.port
+
+    def start(self) -> bool:
+        self.batcher.start()
+        ok = self.server.start()
+        if ok and obs_server.current() is None:
+            # The module slot makes /healthz read the live instruments and
+            # lets run_monitor/note_progress find THE server; an already-
+            # installed one (an ObsSession's) keeps the slot.
+            obs_server.install(self.server)
+            self._installed = True
+        return ok
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        self.server.stop()
+        if self._installed and obs_server.current() is self.server:
+            obs_server.uninstall()
+            self._installed = False
+
+    @contextlib.contextmanager
+    def http_inflight(self):
+        """Active /v1 handler accounting — the drain waits for zero so a
+        response already computed is always written before exit."""
+        with self._inflight_lock:
+            self._http_inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_lock:
+                self._http_inflight -= 1
+
+    def drain(self) -> bool:
+        """Graceful drain: stop admission, finish queued + in-flight work
+        bounded by ``serve.drain_timeout_s``, wait for active handlers to
+        write their responses. Returns whether everything drained in
+        budget."""
+        self._draining = True
+        self.batcher.stop_admission()
+        if self.logger is not None:
+            self.logger.log("serve_admission", tenant="*", action="drain",
+                            queue_depth=sum(
+                                self.batcher.stats()["queued"].values()))
+        drained = self.batcher.drain(self.cfg.serve.drain_timeout_s)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._http_inflight == 0:
+                    break
+            time.sleep(0.01)
+        return drained
+
+    def wait_until_preempted(self) -> None:
+        """The serve loop: heartbeat + stats/SLO cadence until SIGTERM/
+        SIGINT (the shared preemption handler), then drain and raise
+        ``Preempted`` — the CLI maps it to exit 75."""
+        preempt = PreemptionHandler(enabled=self.cfg.resilience.preemption)
+        last_stats = time.monotonic()
+        with preempt:
+            while not preempt.requested:
+                time.sleep(0.05)
+                obs_heartbeat.beat(stage="serve")
+                if (time.monotonic() - last_stats
+                        >= self.cfg.serve.stats_every_s):
+                    self.emit_stats()
+                    last_stats = time.monotonic()
+        drained = self.drain()
+        self.emit_stats()
+        if self.logger is not None:
+            self.logger.log("preempted", signal=preempt.signame, tag="serve",
+                            drained=drained)
+        raise Preempted(preempt.signame)
+
+    # ------------------------------------------------------------ handlers
+
+    def handle_score(self, body: dict) -> tuple[int, dict, dict]:
+        tenant = body.get("tenant") or self.default_tenant
+        method = body.get("method") or self.default_method
+        try:
+            ids = body.get("indices")
+            if ids is not None:
+                images, labels = self.engine.examples_for(tenant, ids)
+            elif body.get("images") is not None:
+                if body.get("labels") is None:
+                    return 400, {"error": "scoring new examples needs "
+                                          "\"labels\" next to \"images\""}, {}
+                images = np.asarray(body["images"], np.float32)
+                labels = np.asarray(body["labels"], np.int32)
+            else:
+                return 400, {"error": "need \"indices\" (registered "
+                                      "examples) or \"images\"+\"labels\""}, {}
+            scores = self.batcher.submit(
+                tenant, method, images, labels,
+                timeout_s=self.cfg.serve.request_timeout_s)
+        except Backpressure as exc:
+            return (429, {"error": str(exc),
+                          "retry_after_s": exc.retry_after_s},
+                    {"Retry-After": max(1, round(exc.retry_after_s))})
+        except Draining:
+            return 503, {"error": "service is draining; admission stopped"}, {}
+        except TimeoutError as exc:
+            return 504, {"error": str(exc)[:300]}, {}
+        except (KeyError, ValueError) as exc:
+            return 400, {"error": str(exc)[:300]}, {}
+        payload = {"tenant": tenant, "method": method, "n": int(len(scores)),
+                   "scores": [float(s) for s in scores]}
+        if ids is not None:
+            payload["indices"] = [int(i) for i in ids]
+        return 200, payload, {}
+
+    def handle_rank(self, body: dict) -> tuple[int, dict, dict]:
+        tenant = body.get("tenant") or self.default_tenant
+        method = body.get("method") or self.default_method
+        ids = body.get("indices")
+        if not ids:
+            return 400, {"error": "rank needs a non-empty \"indices\""}, {}
+        if self._draining:
+            return 503, {"error": "service is draining"}, {}
+        try:
+            ranked, scores = self.engine.rank(tenant, method, ids)
+        except (KeyError, ValueError) as exc:
+            return 400, {"error": str(exc)[:300]}, {}
+        return 200, {"tenant": tenant, "method": method,
+                     "indices": [int(i) for i in ranked],
+                     "scores": [float(s) for s in scores]}, {}
+
+    def topk_prepare(self, tenant: str | None, method: str | None, k: int):
+        """Resolve + force the resident scores (errors surface BEFORE the
+        response status line), returning the streamable item iterator."""
+        tenant = tenant or self.default_tenant
+        method = method or self.default_method
+        if self._draining:
+            raise ValueError("service is draining")
+        self.engine.full_scores(tenant, method)
+        return tenant, method, self.engine.topk(tenant, method, k)
+
+    # --------------------------------------------------------- stats / SLO
+
+    def stats_record(self) -> dict:
+        b = self.batcher.stats()
+        p50 = p95 = None
+        reg = obs_registry.current()
+        if reg is not None:
+            h = reg.snapshot()["histograms"].get("serve_request_ms")
+            if h:
+                p50, p95 = h.get("p50"), h.get("p95")
+        return {
+            "requests": b["accepted"], "completed": b["completed"],
+            "rejected": b["rejected"], "failed": b["failed"],
+            "dispatches": b["dispatches"], "batch_fill": b["batch_fill"],
+            "queued": b["queued"], "inflight": b["inflight"],
+            "admitting": b["admitting"],
+            "p50_ms": p50, "p95_ms": p95,
+            "tenants": sorted(self.engine.tenants),
+            "programs": self.engine.program_stats(),
+            "uptime_s": round(time.time() - self._started_ts, 3),
+        }
+
+    def emit_stats(self) -> dict:
+        """One ``{"kind": "serve_stats"}`` record + the serve-SLO evaluation
+        point + the live gauges — the serve loop's cadence unit."""
+        rec = self.stats_record()
+        self._stats_seq += 1
+        if self.logger is not None:
+            self.logger.log("serve_stats", **rec)
+        queue_depth = sum(rec["queued"].values())
+        submitted = rec["requests"] + rec["rejected"]
+        reject_frac = rec["rejected"] / submitted if submitted else 0.0
+        obs_registry.set_gauge("serve_queue_depth", float(queue_depth))
+        obs_registry.set_gauge("serve_reject_frac", round(reject_frac, 6))
+        if rec["p95_ms"] is not None:
+            obs_registry.set_gauge("serve_p95_ms", rec["p95_ms"])
+        obs_slo.check_serve(point=self._stats_seq, p95_ms=rec["p95_ms"],
+                            queue_depth=queue_depth,
+                            reject_frac=reject_frac, logger=self.logger)
+        return rec
+
+
+def run_serve(cfg: Config, logger) -> dict | None:
+    """The ``cli serve`` body: boot the engine, register the configured
+    tenant, warm the configured methods, serve until preempted (SIGTERM ->
+    drain -> ``Preempted`` -> CLI exit 75)."""
+    from ..train.loop import load_data_for
+    engine = ServeEngine(cfg, logger=logger)
+    train_ds, _ = load_data_for(cfg)
+    tenant = cfg.serve.tenant or cfg.data.dataset
+    engine.register_tenant(tenant, train_ds)
+    service = ServeService(engine, cfg, logger=logger)
+    if not service.start():
+        # For a training run a bind failure degrades observability; for the
+        # serve command serving IS the run — refuse loudly instead of
+        # heartbeating forever behind a port nobody can reach.
+        service.stop()
+        raise RuntimeError(
+            f"serve: could not bind {cfg.serve.host}:{cfg.serve.port} — "
+            "the service has no endpoint; pick a free serve.port (0 = auto)")
+    try:
+        if cfg.serve.warm:
+            for m in default_methods(cfg):
+                t0 = time.perf_counter()
+                engine.full_scores(tenant, m)
+                logger.log("serve_stats", requests=0, dispatches=0,
+                           p95_ms=None, event="warm", tenant=tenant,
+                           method=m,
+                           warm_s=round(time.perf_counter() - t0, 3))
+        service.emit_stats()
+        service.wait_until_preempted()   # raises Preempted on SIGTERM
+        return {"serve": service.stats_record()}
+    finally:
+        service.stop()

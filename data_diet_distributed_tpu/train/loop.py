@@ -1359,6 +1359,19 @@ def pipeline_stages(cfg: Config, logger) -> StageManifest:
                          enabled=cfg.resilience.stage_resume, logger=logger)
 
 
+def pipeline_context(cfg: Config, logger):
+    """The pipeline prologue as ONE reusable unit: ``(mesh, sharder,
+    train_ds, test_ds, stages)``. ``run_datadiet``, ``run_sweep``, the CLI's
+    ``score`` command, and the serving layer's engine boot all construct the
+    same four objects — one definition keeps their mesh/data/stage wiring
+    from drifting (part of the stage-driver split into composable engine
+    units; see ``serve/engine.py``)."""
+    mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
+    sharder = BatchSharder(mesh)
+    train_ds, test_ds = load_data_for(cfg)
+    return mesh, sharder, train_ds, test_ds, pipeline_stages(cfg, logger)
+
+
 def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                    mesh, sharder, logger, ckpt_dir: str, tag: str,
                    score_t: dict[str, float], scoring_shared: bool = False,
@@ -1513,10 +1526,7 @@ def run_sweep(cfg: Config, logger: MetricsLogger | None = None) -> list[dict[str
     """
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
     sweep = sweep_levels(cfg)
-    mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
-    sharder = BatchSharder(mesh)
-    train_ds, test_ds = load_data_for(cfg)
-    stages = pipeline_stages(cfg, logger)
+    mesh, sharder, train_ds, test_ds, stages = pipeline_context(cfg, logger)
 
     scores, score_t = compute_scores(cfg, train_ds, mesh=mesh, sharder=sharder,
                                      logger=logger, stages=stages)
@@ -1554,10 +1564,7 @@ def run_datadiet(cfg: Config, logger: MetricsLogger | None = None) -> dict[str, 
     preempted (exit 75) or crashed run re-invoked with the same config
     re-enters at the exact stage instead of re-scoring from seed 0."""
     logger = logger or MetricsLogger(cfg.obs.metrics_path)
-    mesh = run_mesh(cfg.mesh, elastic=cfg.elastic.enabled)
-    sharder = BatchSharder(mesh)
-    train_ds, test_ds = load_data_for(cfg)
-    stages = pipeline_stages(cfg, logger)
+    mesh, sharder, train_ds, test_ds, stages = pipeline_context(cfg, logger)
 
     t0 = time.perf_counter()
     if cfg.prune.sparsity > 0.0:

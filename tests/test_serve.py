@@ -1,0 +1,447 @@
+"""Scoring-as-a-service acceptance (serve/ + cli serve).
+
+The tier-1 lane for the persistent serving layer: a real in-process service
+over a small CPU dataset answers concurrent ``/v1/score`` + ``/v1/topk``
+requests for two methods (el2n + grand) and must
+
+* bit-match the offline ``score_dataset`` path for the same examples
+  (request batches pad with the ``ScoreResident`` row-0 tail discipline);
+* hit the warm compiled-program cache on the second same-shape request —
+  no recompile, verified via the ``xla_program`` record count AND the
+  engine's own (arch, geometry, method) cache stats;
+* apply backpressure (429 + Retry-After past ``serve.max_queue``) and
+  drain gracefully on SIGTERM (in-flight requests complete, admission
+  stops, ``Preempted`` raised — the CLI's exit-75 contract, pinned for the
+  real process in the subprocess test);
+* look healthy to ``run_monitor --once`` (exit 0) while serving, and
+  trip the serve SLOs (p95 / queue depth / admission floor) when breached.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.obs import slo as obs_slo
+from data_diet_distributed_tpu.obs.session import ObsSession
+from data_diet_distributed_tpu.ops.scoring import score_dataset
+from data_diet_distributed_tpu.resilience.preemption import Preempted
+from data_diet_distributed_tpu.serve.engine import ServeEngine
+from data_diet_distributed_tpu.serve.server import ServeService
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cfg(tmp_path, *extra):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "train.half_precision=false",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+        "serve.port=0", "serve.coalesce_ms=2", "serve.tenant=tiny",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        f"obs.heartbeat_dir={tmp_path}/hb", *extra])
+
+
+def _init_variables(engine, train_ds, seed=0):
+    return jax.jit(engine.model.init, static_argnames=("train",))(
+        jax.random.key(seed),
+        np.zeros((1, *train_ds.images.shape[1:]), np.float32), train=False)
+
+
+def _stream_kinds(path):
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    return recs, [r.get("kind") for r in recs]
+
+
+class TestServeAcceptance:
+    """The ISSUE's acceptance scenario, run once and asserted piecewise."""
+
+    METHODS = ("el2n", "grand")
+
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory, tiny_ds):
+        tmp_path = tmp_path_factory.mktemp("serve")
+        cfg = _cfg(tmp_path)
+        logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+        train_ds, _ = tiny_ds
+        out = dict(cfg=cfg, tmp_path=tmp_path)
+        with ObsSession(cfg, logger=logger):
+            engine = ServeEngine(cfg, logger=logger)
+            variables = _init_variables(engine, train_ds)
+            engine.register_tenant("tiny", train_ds,
+                                   variables_seeds=[variables])
+            # The offline truth: the production score_dataset driver, same
+            # variables, same batch size, same flat sharder.
+            offline = {m: score_dataset(engine.model, [variables], train_ds,
+                                        method=m, batch_size=64,
+                                        sharder=engine.sharder)
+                       for m in self.METHODS}
+            service = ServeService(engine, cfg, logger=logger)
+            assert service.start()
+            sc = _load_tool("serve_client")
+            client = sc.ServeClient(f"http://127.0.0.1:{service.port}",
+                                    timeout_s=300.0)
+            ids = {"el2n": [3, 7, 10, 200], "grand": [0, 5, 251]}
+
+            def do(key, fn):
+                try:
+                    out[key] = fn()
+                except Exception as exc:   # noqa: BLE001 — assert in tests
+                    out[key] = exc
+
+            # Concurrent round 1: score + topk for both methods at once
+            # (cold: every program compiles under concurrent load).
+            threads = [threading.Thread(target=do, args=args) for args in [
+                (f"score1:{m}", lambda m=m: client.score(
+                    indices=ids[m], method=m)) for m in self.METHODS
+            ] + [
+                (f"topk:{m}", lambda m=m: list(client.topk(k=10, method=m)))
+                for m in self.METHODS
+            ]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            out["rank"] = client.rank([0, 1, 2, 3], method="el2n")
+            # Warm-cache evidence boundary: everything is compiled now.
+            _, kinds = _stream_kinds(cfg.obs.metrics_path)
+            out["xla_records_round1"] = kinds.count("xla_program")
+            out["programs_round1"] = engine.program_stats()
+            # Round 2: same shapes again (request geometry is (1, B)
+            # regardless of n; topk reuses resident scores).
+            out["score2:el2n"] = client.score(indices=ids["el2n"],
+                                              method="el2n")
+            out["score2:grand"] = client.score(indices=ids["grand"],
+                                               method="grand")
+            out["topk2:el2n"] = list(client.topk(k=10, method="el2n"))
+            _, kinds = _stream_kinds(cfg.obs.metrics_path)
+            out["xla_records_round2"] = kinds.count("xla_program")
+            out["programs_round2"] = engine.program_stats()
+            # A padded request (n=5) vs a full-tile request (n=64): the
+            # row-0 tail discipline must not leak into real rows.
+            out["score_pad"] = client.score(indices=list(range(5)))
+            out["score_full"] = client.score(indices=list(range(64)))
+            # Live service judged by the CI monitor contract.
+            rm = _load_tool("run_monitor")
+            out["monitor_exit"] = rm.main(
+                ["--port", str(service.port), "--once", "--json"])
+            out["healthz"] = client.healthz()
+            out["status"] = client.status()
+            out["stats"] = service.emit_stats()
+            service.stop()
+        logger.close()
+        out.update(offline=offline, ids=ids, train_ds=train_ds)
+        return out
+
+    def test_concurrent_requests_bitmatch_offline(self, run):
+        for m in self.METHODS:
+            resp = run[f"score1:{m}"]
+            assert not isinstance(resp, Exception), resp
+            served = np.asarray(resp["scores"], np.float32)
+            pos = run["ids"][m]   # synthetic indices == positions
+            assert np.array_equal(served, run["offline"][m][pos]), m
+
+    def test_second_request_bitmatches_too(self, run):
+        for m in self.METHODS:
+            served = np.asarray(run[f"score2:{m}"]["scores"], np.float32)
+            assert np.array_equal(served, run["offline"][m][run["ids"][m]])
+
+    def test_topk_streams_offline_truth(self, run):
+        for m in self.METHODS:
+            got = run[f"topk:{m}"]
+            assert not isinstance(got, Exception), got
+            scores = run["offline"][m]
+            idx = run["train_ds"].indices
+            order = np.lexsort((idx, -scores))[:10]   # pruning's tie-break
+            want = [(int(idx[p]), float(scores[p])) for p in order]
+            assert got == want, m
+        assert run["topk2:el2n"] == run["topk:el2n"]
+
+    def test_rank_orders_slice_hardest_first(self, run):
+        r = run["rank"]
+        scores = run["offline"]["el2n"]
+        want = sorted([0, 1, 2, 3], key=lambda i: (-scores[i], i))
+        assert r["indices"] == want
+        assert r["scores"] == sorted(r["scores"], reverse=True)
+
+    def test_second_same_shape_request_hits_warm_cache(self, run):
+        # No recompile: the xla_program record count (one per compiled
+        # (program, geometry)) is FLAT across round 2...
+        assert run["xla_records_round1"] > 0
+        assert run["xla_records_round2"] == run["xla_records_round1"]
+        # ...and the engine's (arch, geometry, method) cache agrees: same
+        # keys, compile count still 1, dispatch counts grew.
+        p1, p2 = run["programs_round1"], run["programs_round2"]
+        assert set(p1) == set(p2)
+        assert all(e["compiles"] == 1 for e in p2.values()), p2
+        key = "tiny_cnn:(1, 64, 32, 32, 3):el2n"
+        assert p2[key]["dispatches"] > p1[key]["dispatches"]
+
+    def test_padded_tail_scores_bit_identical_to_unpadded(self, run):
+        pad = np.asarray(run["score_pad"]["scores"], np.float32)
+        full = np.asarray(run["score_full"]["scores"], np.float32)
+        assert np.array_equal(pad, full[:5])
+        assert np.array_equal(full, run["offline"]["el2n"][:64])
+
+    def test_run_monitor_once_healthy(self, run):
+        assert run["monitor_exit"] == 0
+        assert run["healthz"]["status"] == "ok"
+
+    def test_status_carries_serve_block(self, run):
+        serve = run["status"]["serve"]
+        assert serve["requests"] >= 6 and serve["rejected"] == 0
+        assert serve["dispatches"] >= 1
+        assert set(serve["programs"]) == set(run["programs_round2"])
+        assert serve["tenants"] == ["tiny"]
+
+    def test_stream_validates_with_serve_kinds(self, run):
+        vm = _load_tool("validate_metrics")
+        recs, kinds = _stream_kinds(run["cfg"].obs.metrics_path)
+        problems = vm.validate_lines([json.dumps(r) for r in recs],
+                                     where="stream")
+        assert problems == [], problems
+        assert "serve_request" in kinds and "serve_stats" in kinds
+        stats = run["stats"]
+        assert stats["p95_ms"] is not None and stats["p95_ms"] > 0
+        assert stats["completed"] == stats["requests"]
+
+
+def test_backpressure_flood_429_with_retry_after(tmp_path, tiny_ds):
+    """Admission control under an injected flood: the engine is blocked
+    (its dispatch lock held), the per-tenant queue bound fills, and the
+    overflow gets 429 + Retry-After while every admitted request still
+    completes once the engine unblocks."""
+    cfg = _cfg(tmp_path, "serve.max_queue=2", "serve.retry_after_s=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    train_ds, _ = tiny_ds
+    engine = ServeEngine(cfg, logger=logger)
+    engine.register_tenant("tiny", train_ds,
+                           variables_seeds=[_init_variables(engine,
+                                                            train_ds)])
+    service = ServeService(engine, cfg, logger=logger)
+    assert service.start()
+    sc = _load_tool("serve_client")
+    client = sc.ServeClient(f"http://127.0.0.1:{service.port}",
+                            timeout_s=120.0)
+    client.score(indices=[0, 1])   # warm the program so the flood is queued,
+    results = []                   # not compiling
+
+    def one(i):
+        try:
+            results.append(("ok", client.score(indices=[i])))
+        except sc.ServeError as err:
+            results.append((err.status, err))
+
+    with engine._lock:   # wedge the dispatcher mid-"compute"
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if sum(1 for s, _ in results if s == 429) >= 2:
+                break
+            time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=120)
+    codes = [s for s, _ in results]
+    # With the dispatcher wedged, admission is bounded: whatever the worker
+    # coalesced into its wedged dispatch plus max_queue=2 queued slots; the
+    # rest of the flood is rejected — and every admitted request still
+    # completes after the wedge clears.
+    assert codes.count("ok") + codes.count(429) == 8, codes
+    assert codes.count(429) >= 2, codes
+    assert codes.count("ok") >= 2, codes
+    rejected = next(e for s, e in results if s == 429)
+    assert rejected.retry_after_s == 2.0   # the Retry-After header round-trip
+    recs, kinds = _stream_kinds(cfg.obs.metrics_path)
+    admissions = [r for r in recs if r.get("kind") == "serve_admission"]
+    assert sum(r["action"] == "reject"
+               for r in admissions) == codes.count(429)
+    # The admission accounting the reject-frac SLO reads at stats points.
+    stats = service.stats_record()
+    assert stats["rejected"] == codes.count(429)
+    assert stats["requests"] == 1 + codes.count("ok")
+    service.stop()
+    logger.close()
+
+
+def test_sigterm_stops_admission_drains_inflight_and_preempts(tmp_path,
+                                                              tiny_ds):
+    """Graceful drain: SIGTERM lands while a request sits in the coalescing
+    window; the serve loop stops admission, the queued request completes
+    with correct scores, a post-drain request is refused (503), and
+    ``Preempted`` raises — which the CLI maps to exit 75 (pinned for the
+    real process in test_cli_serve_subprocess)."""
+    cfg = _cfg(tmp_path, "serve.coalesce_ms=400", "serve.drain_timeout_s=10")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    train_ds, _ = tiny_ds
+    engine = ServeEngine(cfg, logger=logger)
+    engine.register_tenant("tiny", train_ds,
+                           variables_seeds=[_init_variables(engine,
+                                                            train_ds)])
+    offline = score_dataset(engine.model, engine.tenants["tiny"]
+                            .variables_seeds, train_ds, method="el2n",
+                            batch_size=64, sharder=engine.sharder)
+    service = ServeService(engine, cfg, logger=logger)
+    assert service.start()
+    sc = _load_tool("serve_client")
+    client = sc.ServeClient(f"http://127.0.0.1:{service.port}",
+                            timeout_s=60.0)
+    client.score(indices=[0])   # warm: the drain must measure the queue,
+    inflight = {}               # not a compile
+
+    def request():
+        inflight["resp"] = client.score(indices=[5, 6, 7])
+
+    t = threading.Thread(target=request)
+
+    def killer():
+        t.start()
+        time.sleep(0.1)   # the request is inside the 400 ms window
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    threading.Thread(target=killer, daemon=True).start()
+    with pytest.raises(Preempted):
+        service.wait_until_preempted()
+    t.join(timeout=30)
+    # The in-flight request drained to completion, bit-identical.
+    assert np.array_equal(np.asarray(inflight["resp"]["scores"], np.float32),
+                          offline[[5, 6, 7]])
+    # Admission is stopped: a post-drain request is refused, not queued.
+    with pytest.raises(sc.ServeError) as err:
+        client.score(indices=[1])
+    assert err.value.status == 503
+    recs, kinds = _stream_kinds(cfg.obs.metrics_path)
+    assert "preempted" in kinds
+    pre = next(r for r in recs if r["kind"] == "preempted")
+    assert pre["signal"] == "SIGTERM" and pre["drained"] is True
+    drains = [r for r in recs if r.get("kind") == "serve_admission"
+              and r.get("action") == "drain"]
+    assert drains, "drain transition not recorded"
+    service.stop()
+    logger.close()
+
+
+def test_serve_slo_objectives_trip_and_feed_healthz(tmp_path, tiny_ds):
+    """The SLO engine as the service contract: breached p95/queue/admission
+    floors at a stats point emit slo_violation records and degrade the
+    monitor verdict to exit 1."""
+    cfg = _cfg(tmp_path, "obs.slo_serve_p95_ms=0.001",
+               "obs.slo_serve_reject_frac=0.01")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    train_ds, _ = tiny_ds
+    with ObsSession(cfg, logger=logger) as obs:
+        assert obs.slo is not None   # the serve objectives arm the engine
+        engine = ServeEngine(cfg, logger=logger)
+        engine.register_tenant("tiny", train_ds,
+                               variables_seeds=[_init_variables(engine,
+                                                                train_ds)])
+        service = ServeService(engine, cfg, logger=logger)
+        assert service.start()
+        sc = _load_tool("serve_client")
+        client = sc.ServeClient(f"http://127.0.0.1:{service.port}",
+                                timeout_s=120.0)
+        client.score(indices=[0, 1, 2])   # any real latency > 0.001 ms
+        stats = service.emit_stats()
+        assert stats["p95_ms"] > 0.001
+        rm = _load_tool("run_monitor")
+        assert rm.main(["--port", str(service.port), "--once", "--json"]) == 1
+        assert client.healthz()["status"] == "degraded"
+        service.stop()
+    recs, kinds = _stream_kinds(cfg.obs.metrics_path)
+    violations = {r["slo"] for r in recs if r.get("kind") == "slo_violation"}
+    assert "serve_p95" in violations
+    logger.close()
+
+
+def test_slo_check_serve_units():
+    eng = obs_slo.SloEngine(serve_p95_ms=10.0, serve_queue_depth=4,
+                            serve_reject_frac=0.1)
+    eng.check_serve(point=1, p95_ms=50.0, queue_depth=9, reject_frac=0.5)
+    assert eng.total_violations == 3
+    eng.check_serve(point=1, p95_ms=50.0, queue_depth=9, reject_frac=0.5)
+    assert eng.total_violations == 3   # one record per (objective, point)
+    eng.check_serve(point=2, p95_ms=5.0, queue_depth=1, reject_frac=0.0)
+    assert eng.total_violations == 3   # back in contract: no new records
+    names = {v["slo"] for v in eng.violations}
+    assert names == {"serve_p95", "serve_queue_depth", "serve_admission"}
+
+
+def test_cli_serve_subprocess(tmp_path, tiny_ds):
+    """The real process contract: ``cli serve`` boots, answers, and a
+    SIGTERM exits 75 with a schema-valid stream ending in a preempted
+    run_summary."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=str(REPO))
+    metrics = tmp_path / "metrics.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+         "data.dataset=synthetic", "data.synthetic_size=256",
+         "model.arch=tiny_cnn", "score.pretrain_epochs=0",
+         "score.batch_size=64", "score.method=el2n", "serve.port=0",
+         f"obs.metrics_path={metrics}",
+         f"obs.heartbeat_dir={tmp_path}/hb",
+         f"train.checkpoint_dir={tmp_path}/ckpt"],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while port is None and time.monotonic() < deadline:
+            assert proc.poll() is None, proc.stdout.read()[-3000:]
+            time.sleep(0.25)
+            if metrics.exists():
+                for line in open(metrics):
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("kind") == "obs_server":
+                        port = rec["port"]
+        assert port, "service never published its port"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/score",
+            data=json.dumps({"indices": [0, 1, 2]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            scores = json.load(resp)["scores"]
+        assert len(scores) == 3
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == 75, proc.stdout.read()[-3000:]
+    vm = _load_tool("validate_metrics")
+    problems = vm.validate_file(str(metrics), expect_terminal=True)
+    assert problems == [], problems
+    recs, kinds = _stream_kinds(metrics)
+    assert kinds[-1] == "run_summary"
+    assert recs[-1]["exit_class"] == "preempted"
+    assert "serve_stats" in kinds and "preempted" in kinds

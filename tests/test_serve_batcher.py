@@ -1,0 +1,213 @@
+"""The batcher seam (serve/batcher.py): coalescing, fairness, backpressure.
+
+Unit lane over a fake engine (records dispatches, controllable blocking) —
+the real-engine integration (bit-identity, HTTP 429/503, drain) lives in
+test_serve.py. Pinned here:
+
+* the coalescing window honors its deadline both ways — requests arriving
+  inside the window share ONE dispatch, a lone request never waits past
+  the window, and a full batch never waits at all;
+* requests larger than the batch geometry split across dispatches and
+  re-join into one result;
+* per-tenant fairness: with both queues loaded, the drain alternates
+  (weighted round-robin), so a flooding tenant cannot starve another;
+* backpressure: past ``max_queue`` the submit raises ``Backpressure`` with
+  the Retry-After hint, and a draining batcher raises ``Draining``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.serve.batcher import (Backpressure, Draining,
+                                                     ScoreBatcher)
+
+
+class FakeEngine:
+    """Batcher-facing engine stub: scores are the image values themselves
+    (row scatter/re-join is then directly checkable), dispatches recorded,
+    optional gate to wedge the dispatcher."""
+
+    def __init__(self, batch_size=8, delay_s=0.0, weights=None):
+        self.batch_size = batch_size
+        self.delay_s = delay_s
+        self.weights = weights or {}
+        self.dispatches = []
+        self.gate = threading.Event()
+        self.gate.set()
+
+    def tenant_weight(self, name):
+        return self.weights.get(name, 1)
+
+    def score_batch(self, tenant, method, images, labels):
+        self.gate.wait(30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.dispatches.append((tenant, method, len(images)))
+        return np.asarray(images, np.float32)[:, 0]
+
+
+def _imgs(values):
+    return np.asarray(values, np.float32)[:, None]
+
+
+def _submit_async(batcher, tenant, method, values, out, key):
+    def run():
+        try:
+            out[key] = batcher.submit(tenant, method, _imgs(values),
+                                      np.zeros(len(values), np.int32))
+        except Exception as exc:   # noqa: BLE001 — asserted by the test
+            out[key] = exc
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_requests_inside_window_coalesce_into_one_dispatch():
+    eng = FakeEngine(batch_size=8)
+    b = ScoreBatcher(eng, coalesce_window_s=0.3).start()
+    out = {}
+    t1 = _submit_async(b, "a", "el2n", [1, 2], out, "r1")
+    time.sleep(0.05)   # well inside the 300 ms window
+    t2 = _submit_async(b, "a", "el2n", [3], out, "r2")
+    t1.join(10)
+    t2.join(10)
+    assert len(eng.dispatches) == 1        # ONE coalesced dispatch
+    assert eng.dispatches[0] == ("a", "el2n", 3)
+    assert list(out["r1"]) == [1.0, 2.0] and list(out["r2"]) == [3.0]
+    b.stop()
+
+
+def test_lone_partial_request_dispatches_at_the_window_deadline():
+    eng = FakeEngine(batch_size=8)
+    b = ScoreBatcher(eng, coalesce_window_s=0.25).start()
+    t0 = time.monotonic()
+    scores = b.submit("a", "el2n", _imgs([7]), np.zeros(1, np.int32))
+    wall = time.monotonic() - t0
+    assert list(scores) == [7.0]
+    # Waited for the window (the coalescing chance) but not much past it.
+    assert 0.2 <= wall < 1.5, wall
+    b.stop()
+
+
+def test_full_batch_never_waits_for_the_window():
+    eng = FakeEngine(batch_size=4)
+    b = ScoreBatcher(eng, coalesce_window_s=5.0).start()
+    t0 = time.monotonic()
+    scores = b.submit("a", "el2n", _imgs([1, 2, 3, 4]),
+                      np.zeros(4, np.int32))
+    wall = time.monotonic() - t0
+    assert list(scores) == [1.0, 2.0, 3.0, 4.0]
+    assert wall < 2.0, wall   # nowhere near the 5 s window
+    b.stop()
+
+
+def test_oversized_request_splits_and_rejoins():
+    eng = FakeEngine(batch_size=4)
+    b = ScoreBatcher(eng, coalesce_window_s=0.0).start()
+    values = list(range(10))
+    scores = b.submit("a", "el2n", _imgs(values), np.zeros(10, np.int32))
+    assert list(scores) == [float(v) for v in values]
+    assert [n for _, _, n in eng.dispatches] == [4, 4, 2]
+    b.stop()
+
+
+def test_same_tenant_different_methods_never_share_a_dispatch():
+    eng = FakeEngine(batch_size=8)
+    b = ScoreBatcher(eng, coalesce_window_s=0.2).start()
+    out = {}
+    eng.gate.clear()   # hold the worker so both queue up
+    t1 = _submit_async(b, "a", "el2n", [1], out, "r1")
+    t2 = _submit_async(b, "a", "grand", [2], out, "r2")
+    time.sleep(0.1)
+    eng.gate.set()
+    t1.join(10)
+    t2.join(10)
+    assert sorted(m for _, m, _ in eng.dispatches) == ["el2n", "grand"]
+    b.stop()
+
+
+def test_round_robin_fairness_under_contention():
+    """Tenant a floods first; tenant b's requests still drain interleaved —
+    b's dispatches land among a's, not after them."""
+    eng = FakeEngine(batch_size=4)
+    b = ScoreBatcher(eng, coalesce_window_s=0.0).start()
+    eng.gate.clear()   # wedge the worker while both queues load
+    out = {}
+    threads = [_submit_async(b, "a", "el2n", [i] * 4, out, f"a{i}")
+               for i in range(4)]
+    time.sleep(0.1)
+    threads += [_submit_async(b, "b", "el2n", [9] * 4, out, f"b{i}")
+                for i in range(2)]
+    time.sleep(0.1)
+    eng.gate.set()
+    for t in threads:
+        t.join(10)
+    order = [t for t, _, _ in eng.dispatches]
+    assert sorted(order) == ["a"] * 4 + ["b"] * 2
+    # Both b dispatches happen before a's flood finishes (round-robin: at
+    # worst one a-dispatch was already in flight when b enqueued).
+    assert max(i for i, t in enumerate(order) if t == "b") <= 4, order
+    first_b = order.index("b")
+    assert first_b <= 2, order
+    b.stop()
+
+
+def test_weighted_round_robin_gives_weighted_slots():
+    eng = FakeEngine(batch_size=4, weights={"heavy": 2, "light": 1})
+    b = ScoreBatcher(eng, coalesce_window_s=0.0).start()
+    eng.gate.clear()
+    out = {}
+    threads = [_submit_async(b, "heavy", "el2n", [i] * 4, out, f"h{i}")
+               for i in range(4)]
+    threads += [_submit_async(b, "light", "el2n", [i] * 4, out, f"l{i}")
+                for i in range(2)]
+    time.sleep(0.15)
+    eng.gate.set()
+    for t in threads:
+        t.join(10)
+    order = [t for t, _, _ in eng.dispatches]
+    # One full cycle with both pending serves heavy twice per light once.
+    heavy_before_second_light = order[:order.index("light", order.index(
+        "light") + 1)].count("heavy")
+    assert heavy_before_second_light >= 2, order
+    b.stop()
+
+
+def test_backpressure_and_draining_raises():
+    eng = FakeEngine(batch_size=4)
+    b = ScoreBatcher(eng, max_queue=1, retry_after_s=3.0,
+                     coalesce_window_s=0.0).start()
+    eng.gate.clear()
+    out = {}
+    threads = [_submit_async(b, "a", "el2n", [1], out, "r1")]
+    time.sleep(0.2)    # the worker has taken r1 and is wedged dispatching it
+    threads.append(_submit_async(b, "a", "el2n", [2], out, "r2"))
+    time.sleep(0.2)    # r2 fills the single queue slot
+    with pytest.raises(Backpressure) as err:
+        b.submit("a", "el2n", _imgs([4]), np.zeros(1, np.int32))
+    assert err.value.retry_after_s == 3.0
+    assert b.stats()["rejected"] == 1
+    eng.gate.set()
+    for t in threads:
+        t.join(10)
+    assert all(not isinstance(v, Exception) for v in out.values()), out
+    b.stop_admission()
+    with pytest.raises(Draining):
+        b.submit("a", "el2n", _imgs([5]), np.zeros(1, np.int32))
+    assert b.drain(5.0) is True
+    b.stop()
+
+
+def test_dispatch_failure_propagates_to_the_requester():
+    class FailingEngine(FakeEngine):
+        def score_batch(self, tenant, method, images, labels):
+            raise RuntimeError("kaboom")
+
+    b = ScoreBatcher(FailingEngine(), coalesce_window_s=0.0).start()
+    with pytest.raises(RuntimeError, match="kaboom"):
+        b.submit("a", "el2n", _imgs([1]), np.zeros(1, np.int32))
+    assert b.stats()["failed"] == 1
+    b.stop()

@@ -67,7 +67,7 @@ def classify_record(rec: dict) -> str:
 
 
 def lower_is_better(rec: dict) -> bool:
-    return str(rec.get("unit", "")).lower() in ("seconds", "s")
+    return str(rec.get("unit", "")).lower() in ("seconds", "s", "ms")
 
 
 def comm_bytes_per_step(rec: dict) -> float | None:

@@ -1,0 +1,245 @@
+"""Stdlib client + load generator for the scoring service (``cli serve``).
+
+Client (used by ``bench.py --task serve``, the tier-1 tests, and
+operators)::
+
+    from serve_client import ServeClient
+    c = ServeClient("http://127.0.0.1:8788")
+    c.score(indices=[3, 7, 10], method="el2n")      # -> {"scores": [...]}
+    c.rank(indices=[0, 1, 2, 3])                    # hardest-first
+    list(c.topk(k=10, method="grand"))              # streamed (index, score)
+    c.healthz()
+
+curl equivalents (documented in README "Scoring as a service")::
+
+    curl -s localhost:8788/healthz
+    curl -s -X POST localhost:8788/v1/score \
+         -d '{"method": "el2n", "indices": [3, 7, 10]}'
+    curl -s -X POST localhost:8788/v1/rank -d '{"indices": [0, 1, 2, 3]}'
+    curl -sN 'localhost:8788/v1/topk?method=grand&k=10'
+
+Load generator (CLI)::
+
+    python tools/serve_client.py --url http://127.0.0.1:8788 \
+        --rps 50 --duration 5 --batch 16 --max-index 255 --json
+
+Open-loop at ``--rps`` (one request thread per tick, so a slow service
+accumulates concurrency instead of silently lowering the offered rate);
+reports p50/p95/max request latency, 429/error counts, and the achieved
+rate. Exit 0 when every non-rejected request succeeded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+class ServeError(Exception):
+    """A non-2xx service response. Carries the HTTP status and, for 429,
+    the Retry-After hint."""
+
+    def __init__(self, status: int, payload, retry_after_s=None):
+        self.status = status
+        self.payload = payload
+        self.retry_after_s = retry_after_s
+        super().__init__(f"HTTP {status}: {payload}")
+
+
+class ServeClient:
+    def __init__(self, base_url: str, timeout_s: float = 60.0):
+        self.base = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------ plumbing
+
+    def _request(self, path: str, payload: dict | None = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=data,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as err:
+            try:
+                body = json.load(err)
+            except Exception:   # noqa: BLE001 — a torn error body is still an error
+                body = {"error": str(err)}
+            retry_after = err.headers.get("Retry-After")
+            raise ServeError(err.code, body,
+                             float(retry_after) if retry_after else None
+                             ) from None
+
+    # ------------------------------------------------------------ endpoints
+
+    def score(self, *, indices=None, images=None, labels=None,
+              tenant: str | None = None, method: str | None = None) -> dict:
+        payload: dict = {}
+        if tenant:
+            payload["tenant"] = tenant
+        if method:
+            payload["method"] = method
+        if indices is not None:
+            payload["indices"] = [int(i) for i in indices]
+        if images is not None:
+            payload["images"] = images
+            payload["labels"] = labels
+        return self._request("/v1/score", payload)
+
+    def rank(self, indices, *, tenant: str | None = None,
+             method: str | None = None) -> dict:
+        payload: dict = {"indices": [int(i) for i in indices]}
+        if tenant:
+            payload["tenant"] = tenant
+        if method:
+            payload["method"] = method
+        return self._request("/v1/rank", payload)
+
+    def topk(self, k: int = 10, *, tenant: str | None = None,
+             method: str | None = None):
+        """Streamed top-k: yields ``(index, score)`` as lines arrive —
+        the full response never buffers client-side either."""
+        qs = f"k={int(k)}"
+        if tenant:
+            qs += f"&tenant={tenant}"
+        if method:
+            qs += f"&method={method}"
+        req = urllib.request.Request(f"{self.base}/v1/topk?{qs}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        rec = json.loads(line)
+                        yield rec["index"], rec["score"]
+        except urllib.error.HTTPError as err:
+            try:
+                body = json.load(err)
+            except Exception:   # noqa: BLE001
+                body = {"error": str(err)}
+            raise ServeError(err.code, body) from None
+
+    def healthz(self) -> dict:
+        try:
+            return self._request("/healthz")
+        except ServeError as err:
+            if err.status == 503:   # critical verdict still carries its body
+                return err.payload
+            raise
+
+    def status(self) -> dict:
+        return self._request("/status")
+
+
+# -------------------------------------------------------------- load driver
+
+def percentile(values: list[float], q: float) -> float | None:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, round(q * (len(vs) - 1))))
+    return vs[idx]
+
+
+def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
+                  max_index: int = 255, tenant: str | None = None,
+                  method: str | None = None, timeout_s: float = 60.0,
+                  seed: int = 0) -> dict:
+    """Drive ``/v1/score`` open-loop at ``rps`` for ``duration_s``; returns
+    the latency/outcome report dict ``main`` prints (and ``bench.py --task
+    serve`` embeds)."""
+    client = ServeClient(url, timeout_s=timeout_s)
+    rng = random.Random(seed)
+    lock = threading.Lock()
+    lat_ms: list[float] = []
+    outcomes = {"ok": 0, "rejected": 0, "errors": 0}
+    threads: list[threading.Thread] = []
+
+    def one():
+        ids = [rng.randrange(max_index + 1) for _ in range(batch)]
+        t0 = time.perf_counter()
+        try:
+            client.score(indices=ids, tenant=tenant, method=method)
+            wall = (time.perf_counter() - t0) * 1e3
+            with lock:
+                outcomes["ok"] += 1
+                lat_ms.append(wall)
+        except ServeError as err:
+            with lock:
+                outcomes["rejected" if err.status == 429 else "errors"] += 1
+        except Exception:   # noqa: BLE001 — a dead socket is an error outcome
+            with lock:
+                outcomes["errors"] += 1
+
+    interval = 1.0 / max(rps, 1e-9)
+    t_start = time.perf_counter()
+    n_sent = 0
+    while time.perf_counter() - t_start < duration_s:
+        t = threading.Thread(target=one, daemon=True)
+        t.start()
+        threads.append(t)
+        n_sent += 1
+        next_tick = t_start + n_sent * interval
+        delay = next_tick - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+    for t in threads:
+        t.join(timeout=timeout_s)
+    wall = time.perf_counter() - t_start
+    return {
+        "sent": n_sent, "ok": outcomes["ok"],
+        "rejected": outcomes["rejected"], "errors": outcomes["errors"],
+        "offered_rps": round(rps, 2),
+        "achieved_rps": round(outcomes["ok"] / wall, 2) if wall else None,
+        "batch": batch, "wall_s": round(wall, 3),
+        "p50_ms": percentile(lat_ms, 0.50),
+        "p95_ms": percentile(lat_ms, 0.95),
+        "max_ms": max(lat_ms) if lat_ms else None,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Load generator / client for the scoring service")
+    parser.add_argument("--url", required=True,
+                        help="service base URL (http://host:port)")
+    parser.add_argument("--rps", type=float, default=20.0,
+                        help="offered request rate (open loop)")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="load window in seconds")
+    parser.add_argument("--batch", type=int, default=16,
+                        help="examples per /v1/score request")
+    parser.add_argument("--max-index", type=int, default=255,
+                        help="request indices drawn from [0, max-index]")
+    parser.add_argument("--tenant", default=None)
+    parser.add_argument("--method", default=None)
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as one JSON object")
+    args = parser.parse_args(argv)
+    report = load_generate(args.url, rps=args.rps, duration_s=args.duration,
+                           batch=args.batch, max_index=args.max_index,
+                           tenant=args.tenant, method=args.method,
+                           timeout_s=args.timeout)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"sent {report['sent']}  ok {report['ok']}  "
+              f"rejected(429) {report['rejected']}  "
+              f"errors {report['errors']}")
+        print(f"latency ms: p50 {report['p50_ms']}  p95 {report['p95_ms']}  "
+              f"max {report['max_ms']}")
+        print(f"rate: offered {report['offered_rps']}/s  "
+              f"achieved {report['achieved_rps']}/s")
+    return 0 if report["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

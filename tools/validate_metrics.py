@@ -64,6 +64,10 @@ KNOWN_KINDS = frozenset({
     # whole-lineage forensics verdict — emitted by `postmortem --json` and
     # embedded per cycle by the soak driver.
     "postmortem_report",
+    # Scoring-as-a-service (serve/): per-request latency records, the serve
+    # loop's aggregate stats/SLO cadence, and admission-control decisions
+    # (429 rejections, drain transitions).
+    "serve_request", "serve_stats", "serve_admission",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -115,6 +119,12 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     # over a pre-lineage stream, recoveries may be empty — the KEYS must be
     # present so consumers can rely on the shape.
     "postmortem_report": ("attempts", "recoveries", "ok"),
+    # Serving records. Null-tolerant like xla_program: a stats point before
+    # any completed request degrades p95_ms to null — the KEYS must be
+    # present so consumers can rely on the shape.
+    "serve_request": ("tenant", "method", "n", "wall_ms"),
+    "serve_stats": ("requests", "dispatches", "p95_ms"),
+    "serve_admission": ("tenant", "action"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
