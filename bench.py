@@ -356,6 +356,12 @@ def main() -> None:
                         help="serve task: measured load window in seconds")
     parser.add_argument("--request-batch", type=int, default=16,
                         help="serve task: examples per /v1/score request")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serve task: replicas > 1 boots the real "
+                             "ServeFleet (`cli serve` subprocess: N serve "
+                             "children behind the health-aware router) and "
+                             "measures p95 THROUGH the router, ledgered "
+                             "next to the single-process number")
     parser.add_argument("--serve-port", type=int, default=None,
                         help="serve the live obs endpoints (/healthz "
                              "/metrics /status /flightrec) for the duration "
@@ -384,10 +390,13 @@ def main() -> None:
         # a real multi-host TPU slice where each host owns its chips).
         args.no_probe = True
 
+    serve_metric = (f"{args.method}_serve_request_p95_ms"
+                    if args.replicas <= 1 else
+                    f"{args.method}_serve_fleet{args.replicas}_request_p95_ms")
     metric = {"score": f"{args.method}_scoring_examples_per_sec_per_chip",
               "train": "train_examples_per_sec_per_chip",
               "northstar": "grand_northstar_wall_s",
-              "serve": f"{args.method}_serve_request_p95_ms"}[args.task]
+              "serve": serve_metric}[args.task]
     unit = {"northstar": "seconds", "serve": "ms"}.get(args.task,
                                                        "examples/sec/chip")
 
@@ -474,6 +483,8 @@ def main() -> None:
                     bench_train(args, metric)
                 elif args.task == "northstar":
                     bench_northstar(args, metric)
+                elif args.task == "serve" and args.replicas > 1:
+                    bench_serve_fleet(args, metric)
                 elif args.task == "serve":
                     bench_serve(args, metric)
                 else:
@@ -804,6 +815,126 @@ def bench_northstar(args, metric: str) -> None:
 #: comfortably (the vs_baseline denominator; the ledger trail is the real
 #: regression judge, per-shape like every other metric).
 SERVE_BUDGET_P95_MS = 100.0
+
+
+def bench_serve_fleet(args, metric: str) -> None:
+    """Fleet latency THROUGH the production router: boot ``cli serve`` with
+    ``serve.replicas=N`` as a real subprocess (N serve children, each its
+    own mesh + port, behind the health-aware router), wait for full
+    capacity, then drive the same open-loop ``/v1/score`` load as the
+    single-process bench. The ledger line lands NEXT to the single-process
+    one (``…_serve_fleetN_request_p95_ms`` vs ``…_serve_request_p95_ms``),
+    so the router's cost — proxy hop, idempotency bookkeeping, retries —
+    is a diffable number, not an assertion."""
+    import importlib.util
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_client", os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "tools", "serve_client.py"))
+    serve_client = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_client)
+
+    stem = args.stem or ("imagenet" if args.dataset == "synthetic_imagenet"
+                         else "cifar")
+    work = tempfile.mkdtemp(prefix="bench_serve_fleet_")
+    metrics_path = os.path.join(work, "metrics.jsonl")
+    argv = [
+        sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+        f"data.dataset={args.dataset}", f"data.synthetic_size={args.size}",
+        f"model.arch={args.arch}", f"model.stem={stem}",
+        f"score.method={args.method}", "score.pretrain_epochs=0",
+        f"score.batch_size={args.batch}",
+        f"score.grand_chunk={args.grand_chunk}",
+        f"serve.replicas={args.replicas}", "serve.router_port=0",
+        "serve.port=0", "serve.request_log=false", "serve.tenant=bench",
+        "serve.warm=false",
+        f"obs.metrics_path={metrics_path}",
+        f"obs.heartbeat_dir={os.path.join(work, 'hb')}",
+        f"train.checkpoint_dir={os.path.join(work, 'ckpt')}"]
+    if args.no_pallas:
+        argv.append("score.use_pallas=false")
+    if args.mesh:
+        d, m = parse_mesh(args.mesh)
+        argv += [f"mesh.data_axis={d}", f"mesh.model_axis={m}"]
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else repo)
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + 600
+        while port is None and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("fleet exited during boot:\n"
+                                   + proc.stdout.read()[-4000:])
+            time.sleep(0.25)
+            if os.path.exists(metrics_path):
+                for line in open(metrics_path):
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (rec.get("kind") == "serve_fleet"
+                            and rec.get("event") == "launch"):
+                        port = rec["router_port"]
+        if not port:
+            raise RuntimeError("fleet never published its router port")
+        url = f"http://127.0.0.1:{port}"
+        probe = serve_client.ServeClient(url, timeout_s=10.0)
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            try:
+                if probe.healthz().get("available") == args.replicas:
+                    break
+            except serve_client.ServeError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("fleet never reached full capacity")
+        client = serve_client.ServeClient(url, timeout_s=600.0, retries=4)
+        ids = list(range(min(args.request_batch, args.size)))
+        t0 = time.perf_counter()
+        client.score(indices=ids)   # cold: every replica compiles lazily
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        # Warm EVERY replica (round-robin) before the measured window.
+        for _ in range(args.replicas * 2):
+            client.score(indices=ids)
+        report = serve_client.load_generate(
+            url, rps=args.rps, duration_s=args.duration,
+            batch=min(args.request_batch, args.size),
+            max_index=args.size - 1, timeout_s=600.0, retries=4)
+        if report["p95_ms"] is None:
+            raise RuntimeError(
+                f"fleet load window completed no requests: {report}")
+        router = probe.status()["router"]
+        proc.send_signal(_signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != EXIT_PREEMPTED:
+            raise RuntimeError(f"fleet SIGTERM exit was {rc}, expected "
+                               f"{EXIT_PREEMPTED}:\n"
+                               + proc.stdout.read()[-4000:])
+        emit(metric, round(report["p95_ms"], 3), "ms",
+             round(SERVE_BUDGET_P95_MS / report["p95_ms"], 4),
+             p50_ms=report["p50_ms"], max_ms=report["max_ms"],
+             cold_ms=round(cold_ms, 3), replicas=args.replicas,
+             requests=report["sent"], ok=report["ok"],
+             rejected=report["rejected"], request_errors=report["errors"],
+             request_retries=report["retried"],
+             offered_rps=report["offered_rps"],
+             achieved_rps=report["achieved_rps"],
+             router_retries=router["retries"],
+             router_replays=router["replays"],
+             router_hedges=router["hedges"])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(work, ignore_errors=True)
 
 
 def bench_serve(args, metric: str) -> None:

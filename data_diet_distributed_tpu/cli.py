@@ -50,6 +50,34 @@ def main(argv: list[str] | None = None) -> int:
     run_started = time.time()
     command, cfg, args = _build(sys.argv[1:] if argv is None else argv)
     from .resilience import elastic as elastic_mod
+    if command == "serve" and cfg.serve.replicas > 1 \
+            and os.environ.get("DDT_SERVE_REPLICA") is None:
+        # Serve-fleet supervisor mode: jax-free like the elastic
+        # supervisor — spawns `serve.replicas` single-replica children of
+        # this same invocation (DDT_SERVE_REPLICA set, serve.replicas=1
+        # forced, so they take the serving path below), fronts them with
+        # the health-aware router, and respawns casualties per the fleet
+        # policy. Checked BEFORE the elastic branch: a serve command with
+        # replicas is a fleet, whatever elastic.enabled says.
+        from .serve.fleet import ServeFleet
+        logger = elastic_mod.JsonlLogger(cfg.obs.metrics_path)
+        fleet = ServeFleet(cfg, config_path=args.config,
+                           overrides=args.overrides, logger=logger)
+        mono0 = time.perf_counter()
+        try:
+            rc = fleet.run()
+        except BaseException:
+            logger.log("run_summary",
+                       wall_s=round(time.perf_counter() - mono0, 3),
+                       exit_class="fatal:supervisor", command=command)
+            logger.close()
+            raise
+        logger.log("run_summary",
+                   wall_s=round(time.perf_counter() - mono0, 3),
+                   exit_class=fleet.exit_class(rc), command=command,
+                   lineage=fleet.lineage_block())
+        logger.close()
+        return rc
     if cfg.elastic.enabled and os.environ.get(elastic_mod.CHILD_ENV) != "1":
         # Elastic supervisor mode: this process never touches jax — it
         # spawns `elastic.world` worker ranks of this same invocation

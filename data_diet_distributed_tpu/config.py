@@ -357,6 +357,45 @@ class ServeConfig:
     # Per-request {"kind": "serve_request"} records (tenant/method/n/walls).
     # Disable for genuinely heavy traffic; serve_stats aggregates remain.
     request_log: bool = True
+    # --- serving fleet (serve/fleet.py + serve/router.py) ---------------
+    # replicas > 1 turns `cli serve` into a ServeFleet supervisor: N serve
+    # replicas as child processes (each its own mesh + port), fronted by a
+    # health-aware router on `port`/`router_port`. 1 = single process
+    # (the PR-13 behaviour, unchanged).
+    replicas: int = 1
+    # Router's public port (0 = auto-pick; logged as obs_server). The
+    # per-replica backend ports are always auto-picked by the fleet.
+    router_port: int = 0
+    # Serve-side watchdog: a score dispatch in flight longer than this
+    # makes /healthz critical (wedged dispatcher) -> the router stops
+    # routing there and the fleet drains + respawns the replica.
+    # None = watchdog off.
+    dispatch_stall_s: float | None = 30.0
+    # Zero-downtime refresh: poll the refresh checkpoint dir for a newer
+    # step this often and roll it across replicas. None = manual only
+    # (POST /v1/refresh).
+    refresh_poll_s: float | None = None
+    # Checkpoint dir refreshes restore from; None -> train.checkpoint_dir.
+    # Digest-verified (CheckpointManager.restore_checked) before install.
+    refresh_from: str | None = None
+    # Router retry budget for idempotent requests (requests carrying an
+    # Idempotency-Key header) across replicas, within request_timeout_s.
+    route_retries: int = 2
+    # Per-replica circuit breaker: this many consecutive transport
+    # failures open the circuit; after breaker_reset_s one probe request
+    # is let through (half-open) and a success closes it.
+    breaker_failures: int = 3
+    breaker_reset_s: float = 2.0
+    # Tail-latency hedging: an idempotent request still unanswered after
+    # this many ms is duplicated to a second replica, first answer wins
+    # (the loser's connection is closed). None = off.
+    hedge_ms: float | None = None
+    # Fleet health-poll cadence (per-replica /healthz) in seconds.
+    health_poll_s: float = 0.5
+    # Router idempotency-replay cache entries (bounded LRU keyed by the
+    # Idempotency-Key header; a retried request replays the cached
+    # response instead of double-dispatching).
+    idempotency_cache: int = 256
 
 
 @dataclass
@@ -540,6 +579,14 @@ class ObsConfig:
     # ...and the admission floor: max tolerated rejected fraction of all
     # submitted requests (429s / accepted+rejected) over the run so far.
     slo_serve_reject_frac: float | None = None
+    # Fleet-level serving SLOs (serve/fleet.py): evaluated at every
+    # serve_fleet stats point while a replicated fleet runs. Router-side
+    # p95 request latency budget in milliseconds (includes retry/hedge
+    # walls — what a client actually sees)...
+    slo_fleet_p95_ms: float | None = None
+    # ...and the availability floor: minimum fraction of replicas healthy
+    # (routable) at a fleet stats point, in (0, 1].
+    slo_fleet_available_frac: float | None = None
     # Cross-attempt recovery budget (seconds): time from the supervisor's
     # fault classification to the FIRST post-resume training step of the
     # relaunched attempt, computed from the lineage-stamped records in the
@@ -752,6 +799,14 @@ class Config:
             raise ValueError(
                 f"obs.slo_serve_reject_frac must be in [0, 1), got "
                 f"{o.slo_serve_reject_frac}")
+        if o.slo_fleet_p95_ms is not None and o.slo_fleet_p95_ms <= 0:
+            raise ValueError(
+                f"obs.slo_fleet_p95_ms must be > 0, got {o.slo_fleet_p95_ms}")
+        if (o.slo_fleet_available_frac is not None
+                and not 0.0 < o.slo_fleet_available_frac <= 1.0):
+            raise ValueError(
+                f"obs.slo_fleet_available_frac must be in (0, 1], got "
+                f"{o.slo_fleet_available_frac}")
         sv = self.serve
         if not 0 <= sv.port <= 65535:
             raise ValueError(
@@ -780,6 +835,40 @@ class Config:
                 "drain_timeout_s/stats_every_s > 0; got "
                 f"{sv.retry_after_s}/{sv.request_timeout_s}/"
                 f"{sv.drain_timeout_s}/{sv.stats_every_s}")
+        if sv.replicas < 1:
+            raise ValueError(f"serve.replicas must be >= 1, got "
+                             f"{sv.replicas}")
+        if not 0 <= sv.router_port <= 65535:
+            raise ValueError(
+                f"serve.router_port must be in [0, 65535] (0 = auto-pick), "
+                f"got {sv.router_port}")
+        if sv.dispatch_stall_s is not None and sv.dispatch_stall_s <= 0:
+            raise ValueError(
+                f"serve.dispatch_stall_s must be > 0 (or null for no "
+                f"watchdog), got {sv.dispatch_stall_s}")
+        if sv.refresh_poll_s is not None and sv.refresh_poll_s <= 0:
+            raise ValueError(
+                f"serve.refresh_poll_s must be > 0 (or null for manual "
+                f"refresh only), got {sv.refresh_poll_s}")
+        if sv.route_retries < 0:
+            raise ValueError(f"serve.route_retries must be >= 0, got "
+                             f"{sv.route_retries}")
+        if sv.breaker_failures < 1:
+            raise ValueError(f"serve.breaker_failures must be >= 1, got "
+                             f"{sv.breaker_failures}")
+        if sv.breaker_reset_s <= 0:
+            raise ValueError(f"serve.breaker_reset_s must be > 0, got "
+                             f"{sv.breaker_reset_s}")
+        if sv.hedge_ms is not None and sv.hedge_ms <= 0:
+            raise ValueError(
+                f"serve.hedge_ms must be > 0 (or null for no hedging), "
+                f"got {sv.hedge_ms}")
+        if sv.health_poll_s <= 0:
+            raise ValueError(f"serve.health_poll_s must be > 0, got "
+                             f"{sv.health_poll_s}")
+        if sv.idempotency_cache < 1:
+            raise ValueError(f"serve.idempotency_cache must be >= 1, got "
+                             f"{sv.idempotency_cache}")
         return self
 
 

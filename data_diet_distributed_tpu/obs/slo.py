@@ -47,7 +47,8 @@ import os
 import numpy as np
 
 __all__ = ["SloEngine", "ledger_baseline", "install", "uninstall", "current",
-           "check_epoch", "check_scores", "DEFAULT_BASELINE_WINDOW"]
+           "check_epoch", "check_scores", "check_serve", "check_fleet",
+           "DEFAULT_BASELINE_WINDOW"]
 
 #: Trailing clean records forming the ledger baseline (the sentry's window).
 DEFAULT_BASELINE_WINDOW = 5
@@ -122,6 +123,8 @@ class SloEngine:
                  serve_p95_ms: float | None = None,
                  serve_queue_depth: int | None = None,
                  serve_reject_frac: float | None = None,
+                 fleet_p95_ms: float | None = None,
+                 fleet_available_frac: float | None = None,
                  baseline_window: int = DEFAULT_BASELINE_WINDOW,
                  geometry: dict | None = None, logger=None):
         self.throughput_floor = throughput_floor
@@ -147,6 +150,11 @@ class SloEngine:
         self.serve_p95_ms = serve_p95_ms
         self.serve_queue_depth = serve_queue_depth
         self.serve_reject_frac = serve_reject_frac
+        # Fleet contract (serve/fleet.py): router-side p95 budget across the
+        # whole replicated pod, and the availability floor (fraction of
+        # replicas routable) — evaluated at every serve_fleet stats point.
+        self.fleet_p95_ms = fleet_p95_ms
+        self.fleet_available_frac = fleet_available_frac
         self.baseline_window = baseline_window
         self.logger = logger
         self.violations: list[dict] = []   # bounded retention (MAX_RETAINED)
@@ -170,7 +178,8 @@ class SloEngine:
                 o.slo_heartbeat_stale_s, o.slo_nonfinite_frac,
                 o.slo_eval_accuracy_floor, o.slo_recovery_s,
                 o.slo_serve_p95_ms, o.slo_serve_queue_depth,
-                o.slo_serve_reject_frac)):
+                o.slo_serve_reject_frac, o.slo_fleet_p95_ms,
+                o.slo_fleet_available_frac)):
             return None
         # The SAME geometry block cli._append_perf_ledger writes: the
         # baseline this run is held to is the trail of runs of its own shape.
@@ -188,6 +197,8 @@ class SloEngine:
                    serve_p95_ms=o.slo_serve_p95_ms,
                    serve_queue_depth=o.slo_serve_queue_depth,
                    serve_reject_frac=o.slo_serve_reject_frac,
+                   fleet_p95_ms=o.slo_fleet_p95_ms,
+                   fleet_available_frac=o.slo_fleet_available_frac,
                    logger=logger)
 
     # ----------------------------------------------------------- plumbing
@@ -198,7 +209,8 @@ class SloEngine:
         out = {k: getattr(self, k) for k in
                ("throughput_floor", "throughput_frac", "heartbeat_stale_s",
                 "nonfinite_frac", "eval_accuracy_floor", "recovery_s",
-                "serve_p95_ms", "serve_queue_depth", "serve_reject_frac")
+                "serve_p95_ms", "serve_queue_depth", "serve_reject_frac",
+                "fleet_p95_ms", "fleet_available_frac")
                if getattr(self, k) is not None}
         if self._baseline_resolved:
             out["throughput_baseline"] = self._baseline
@@ -401,6 +413,27 @@ class SloEngine:
                           point=("serve_admission", point))
         self._mark_ok()
 
+    def check_fleet(self, *, point, p95_ms: float | None = None,
+                    available_frac: float | None = None,
+                    logger=None) -> None:
+        """Fleet-contract evaluation, once per serve_fleet stats point:
+        router-observed p95 request latency vs ``slo_fleet_p95_ms`` and
+        routable-replica fraction vs ``slo_fleet_available_frac``. Same
+        point discipline as ``check_serve``: a sustained breach re-records
+        at each new point, never twice for the same one."""
+        if (self.fleet_p95_ms is not None and p95_ms is not None
+                and p95_ms > self.fleet_p95_ms):
+            self._violate("fleet_p95", round(float(p95_ms), 3),
+                          self.fleet_p95_ms, logger=logger,
+                          point=("fleet_p95", point))
+        if (self.fleet_available_frac is not None
+                and available_frac is not None
+                and available_frac < self.fleet_available_frac):
+            self._violate("fleet_availability", round(float(available_frac), 6),
+                          self.fleet_available_frac, logger=logger,
+                          point=("fleet_availability", point))
+        self._mark_ok()
+
     def check_scores(self, method: str, scores, *, logger=None) -> None:
         """Scoring-pass evaluation: the nonfinite-score budget over the
         final score vector (a scoring pass whose output is part-NaN is a
@@ -455,6 +488,13 @@ def check_serve(**kwargs) -> None:
     engine with serve objectives is installed."""
     if _ENGINE is not None:
         _ENGINE.check_serve(**kwargs)
+
+
+def check_fleet(**kwargs) -> None:
+    """Library-code entry (the fleet supervisor's stats points): no-op
+    until an engine with fleet objectives is installed."""
+    if _ENGINE is not None:
+        _ENGINE.check_fleet(**kwargs)
 
 
 def arm_recovery(metrics_path: str | None) -> None:

@@ -185,7 +185,8 @@ def build_timeline(artifacts: dict) -> list[dict]:
                        **{k: rec[k] for k in ("event", "fault", "stage",
                                               "status", "step", "epoch",
                                               "world", "saved_world", "slo",
-                                              "signal", "cause", "exit_class")
+                                              "signal", "cause", "exit_class",
+                                              "replica")
                           if k in rec}})
     for dumped in artifacts.get("flightrec") or []:
         rank, attempt = dumped.get("rank"), dumped.get("attempt")

@@ -282,11 +282,50 @@ def clear_rank_artifacts(checkpoint_dir: str, heartbeat_dir: str | None,
 
 # ----------------------------------------------------------- the supervisor
 
-def _free_port() -> int:
+def free_port() -> int:
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+_free_port = free_port   # historical name; both supervisors use it
+
+
+def classify_rc(rc: int) -> str:
+    """The CLI exit-status contract, named — shared by every supervisor
+    (elastic pod, serve fleet) and their terminal ``run_summary`` records."""
+    if rc == 0:
+        return "ok"
+    if rc == EXIT_PREEMPTED:
+        return "preempted"
+    if rc == EXIT_RETRIABLE:
+        return "retriable"
+    if rc == EXIT_DIVERGED:
+        return "diverged"
+    return f"fatal:rc{rc}"
+
+
+class RestartBudget:
+    """Bounded-restart accounting shared by the supervisors: ``max_restarts``
+    relaunches, exponential backoff between them (exponent capped so a long
+    soak never sleeps unboundedly). A requested grow/resize is free — only
+    failure relaunches spend."""
+
+    def __init__(self, max_restarts: int, backoff_s: float):
+        self.max_restarts = int(max_restarts)
+        self.left = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+
+    def exhausted(self) -> bool:
+        return self.left <= 0
+
+    def spend(self, exponent: int) -> float:
+        """Spend one relaunch; returns the backoff (seconds) to sleep before
+        it. Callers check ``exhausted()`` first — spending past zero is a
+        supervisor bug, not a policy."""
+        self.left -= 1
+        return self.backoff_s * (2 ** min(int(exponent), 6))
 
 
 class ElasticSupervisor:
@@ -324,7 +363,7 @@ class ElasticSupervisor:
         self.initial_world = self.world
         self.min_world = int(e.min_world)
         self.max_world = int(e.max_world or self.world)
-        self.restarts_left = int(e.max_restarts)
+        self.budget = RestartBudget(int(e.max_restarts), float(e.backoff_s))
         self.backoff_s = float(e.backoff_s)
         self.reap_timeout_s = float(e.reap_timeout_s)
         self.stale_after_s = float(e.heartbeat_stale_s)
@@ -354,6 +393,10 @@ class ElasticSupervisor:
         self.log_dir = elastic_dir(ckpt)
 
     # ------------------------------------------------------------- plumbing
+
+    @property
+    def restarts_left(self) -> int:
+        return self.budget.left
 
     def _next_attempt(self) -> None:
         self.attempt += 1
@@ -599,7 +642,7 @@ class ElasticSupervisor:
                 if not self.cfg.elastic.resume_preempted:
                     self._event("preempted_exit")
                     return EXIT_PREEMPTED
-            if self.restarts_left <= 0:
+            if self.budget.exhausted():
                 for rank, rc in enumerate(rcs):
                     if rc not in (0,):
                         print(f"[elastic] rank {rank} rc={rc} tail:\n"
@@ -607,7 +650,7 @@ class ElasticSupervisor:
                               flush=True)
                 self._event("give_up", last_rcs=rcs)
                 return max((rc for rc in rcs if rc > 0), default=1)
-            self.restarts_left -= 1
+            backoff = self.budget.spend(self.attempt)
             if action == "shrink":
                 # Only exit-by-signal ranks are LOST hosts. A stale
                 # heartbeat alone (info["stale_ranks"], reported for
@@ -625,7 +668,6 @@ class ElasticSupervisor:
                 self.world = new_world
             else:
                 self._event("restart", restarts_left=self.restarts_left)
-            backoff = self.backoff_s * (2 ** min(self.attempt, 6))
             if backoff:
                 time.sleep(backoff)
             self._next_attempt()
@@ -653,12 +695,4 @@ class ElasticSupervisor:
                 "supervision_gap_s": round(self._lost_wall_s, 3)}
 
     def exit_class(self, rc: int) -> str:
-        if rc == 0:
-            return "ok"
-        if rc == EXIT_PREEMPTED:
-            return "preempted"
-        if rc == EXIT_RETRIABLE:
-            return "retriable"
-        if rc == EXIT_DIVERGED:
-            return "diverged"
-        return f"fatal:rc{rc}"
+        return classify_rc(rc)

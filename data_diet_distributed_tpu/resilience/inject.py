@@ -73,6 +73,18 @@ class FaultPlan:
     # consensus restore — as if its final async save never landed (the
     # divergent-latest-checkpoint drill).
     hide_latest_durable: bool = False
+    # --- serve fault classes (serve/batcher.py dispatch path) ----------
+    # SIGKILL self once >= K requests have completed — non-graceful like
+    # kill_rank_after_epoch, fired at the START of the next dispatch so the
+    # batch being assembled dies with its HTTP requests in flight: the
+    # router's replay path is what the drill proves. Replica-targetable via
+    # ``rank`` (a serve replica reads DDT_SERVE_REPLICA as its rank).
+    kill_replica_after_requests: int | None = None
+    # Hang the dispatcher thread (interruptible sleep of ``hang_seconds``)
+    # at the start of dispatch number K — the wedged-replica drill: requests
+    # keep queueing, /healthz goes critical past serve.dispatch_stall_s,
+    # the fleet drains + respawns.
+    wedge_dispatcher_after: int | None = None
     rank: int | None = None                # target process_index (None = all)
 
 
@@ -84,9 +96,15 @@ class FaultInjector:
     def _rank_targeted(self) -> bool:
         """True when this process is the plan's target (always, untargeted).
         jax imports lazily and only for targeted plans — this module stays
-        importable (and firable single-process) before backend init."""
+        importable (and firable single-process) before backend init. A serve
+        replica's rank is its fleet index (DDT_SERVE_REPLICA, set by
+        serve/fleet.py) — the same ``rank`` key targets one replica of a
+        fleet exactly like one rank of a pod, and without touching jax."""
         if self.plan.rank is None:
             return True
+        replica = os.environ.get("DDT_SERVE_REPLICA")
+        if replica is not None:
+            return int(replica) == self.plan.rank
         import jax
         return jax.process_index() == self.plan.rank
 
@@ -139,6 +157,25 @@ class FaultInjector:
         elif site == "seed_scored":
             if self._due("sigterm_after_seed_scores", ctx["completed"]):
                 os.kill(os.getpid(), signal.SIGTERM)
+        elif site == "serve_dispatch":
+            # Threshold coordinates (>=), not exact equality like _due: a
+            # dispatch coalesces a variable number of requests, so the
+            # completed-request counter can jump PAST an exact K between
+            # dispatches without ever equalling it.
+            k = self.plan.kill_replica_after_requests
+            if k is not None and ctx["completed"] >= k \
+                    and "kill_replica_after_requests" not in self.fired \
+                    and self._rank_targeted():
+                self.fired.add("kill_replica_after_requests")
+                # Non-graceful: the dispatch about to run — and every HTTP
+                # request riding it — dies unanswered. SIGKILL, no drain.
+                os.kill(os.getpid(), signal.SIGKILL)
+            k = self.plan.wedge_dispatcher_after
+            if k is not None and ctx["dispatch"] >= k \
+                    and "wedge_dispatcher_after" not in self.fired \
+                    and self._rank_targeted():
+                self.fired.add("wedge_dispatcher_after")
+                time.sleep(self.plan.hang_seconds)
         elif site == "checkpoint_saved":
             if self._due("truncate_after_save_step", ctx["step"]):
                 # Barrier on the async save first: truncating a file that is

@@ -19,10 +19,19 @@ Four layers:
   /healthz /metrics /status from the existing obs stack) and the
   ``cli serve`` entry with graceful SIGTERM drain (exit 75);
 * the SLO engine (``obs/slo.py``) as the service contract
-  (``slo_serve_p95_ms``, queue-depth and admission floors) feeding
-  /healthz and ``run_monitor --once``.
+  (``slo_serve_p95_ms``, queue-depth and admission floors, plus the
+  fleet-level ``slo_fleet_p95_ms``/``slo_fleet_available_frac``) feeding
+  /healthz and ``run_monitor --once``;
+* ``router.py`` — the health-aware reverse proxy over a replicated pod
+  (circuit breaking, idempotent retry/replay, optional hedging, rolled
+  zero-downtime refresh);
+* ``fleet.py``  — the ``serve.replicas > 1`` supervisor: N replicas as
+  child processes behind the router, wedged/killed replicas respawned on
+  the elastic pod's bounded-restart machinery.
 """
 
 from .batcher import Backpressure, Draining, ScoreBatcher  # noqa: F401
 from .engine import ServeEngine  # noqa: F401
+from .fleet import ServeFleet, discover_steps  # noqa: F401
+from .router import CircuitBreaker, Replica, ServeRouter  # noqa: F401
 from .server import ServeServer, ServeService, run_serve  # noqa: F401

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
+from ..resilience import inject
 
 
 class Backpressure(Exception):
@@ -102,6 +103,12 @@ class ScoreBatcher:
         self.rows_dispatched = 0
         self.rows_padded = 0
         self._thread: threading.Thread | None = None
+        # Serve-side watchdog evidence: monotonic start of the dispatch the
+        # worker is INSIDE right now (None between dispatches). A wedged
+        # dispatcher — engine hang, injected wedge — leaves this set, and
+        # ``dispatch_age_s()`` is what /healthz judges against
+        # serve.dispatch_stall_s.
+        self._dispatch_started: float | None = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -196,6 +203,14 @@ class ScoreBatcher:
     def _pending_locked(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    def dispatch_age_s(self) -> float | None:
+        """Seconds the in-flight dispatch has been running, or None when the
+        worker is between dispatches. Read without the lock on purpose: the
+        wedged dispatcher this exists to expose may be holding nothing OR
+        anything, and a float read is atomic enough for a watchdog."""
+        started = self._dispatch_started
+        return None if started is None else time.monotonic() - started
+
     def stats(self) -> dict:
         with self._cv:
             return {
@@ -288,11 +303,20 @@ class ScoreBatcher:
     def _dispatch(self, tenant: str, method: str, parts) -> None:
         images = np.concatenate([r.images[o:o + n] for r, o, n in parts])
         labels = np.concatenate([r.labels[o:o + n] for r, o, n in parts])
+        self._dispatch_started = time.monotonic()
         try:
+            # Serve fault site (kill_replica_after_requests /
+            # wedge_dispatcher_after): fired with the dispatch in flight so
+            # the parts' HTTP requests are exactly the in-flight work the
+            # fault orphans.
+            inject.fire("serve_dispatch", dispatch=self.dispatches + 1,
+                        completed=self.completed)
             scores = self.engine.score_batch(tenant, method, images, labels)
             error = None
         except Exception as exc:   # noqa: BLE001 — the requester gets the failure
             scores, error = None, exc
+        finally:
+            self._dispatch_started = None
         now = time.monotonic()
         done: list[_Request] = []
         with self._cv:

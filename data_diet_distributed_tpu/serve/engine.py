@@ -157,6 +157,55 @@ class ServeEngine:
             self.tenants[name] = tenant
         return tenant
 
+    def load_checkpoint_variables(self, directory: str,
+                                  step: int | None = None) -> tuple[dict, int]:
+        """Scoring variables (``{params, batch_stats}``) from a training
+        run's checkpoint, digest-verified BEFORE anything is installed:
+        ``restore_checked`` restores exactly the named step against its
+        save-time manifest with no fallback — a truncated/corrupt refresh
+        source fails loudly HERE while the tenant's old model keeps serving.
+        ``step`` None takes the newest durable step (tier steps included,
+        the same discovery every restore path uses). Returns
+        ``(variables, step)``. Deliberately NOT under ``_lock``: the restore
+        is the slow half of a refresh and must not stall dispatches."""
+        from ..checkpoint import CheckpointManager
+        from ..train.state import create_train_state
+        template = create_train_state(self.cfg, jax.random.key(0),
+                                      steps_per_epoch=1)
+        mngr = CheckpointManager(directory,
+                                 max_to_keep=self.cfg.train.keep_checkpoints)
+        try:
+            step = mngr.latest_step() if step is None else int(step)
+            if step is None:
+                raise FileNotFoundError(
+                    f"{directory}: no durable checkpoint step to refresh "
+                    "from")
+            restored = mngr.restore_checked(template, step)
+        finally:
+            mngr.close()
+        return ({"params": restored.params,
+                 "batch_stats": restored.batch_stats}, int(step))
+
+    def refresh_tenant(self, name: str, variables_seeds: Sequence) -> None:
+        """Atomically install new scoring variables for ``name``.
+
+        The swap is ONE assignment under ``_lock`` — the same lock every
+        dispatch (``score_batch``) and resident pass (``full_scores``) holds
+        for its whole duration — so any request is served entirely by the
+        old variables or entirely by the new ones, never a torn mix. The
+        cached resident score vectors are invalidated in the same critical
+        section (they were computed by the old model); the ``ScoreResident``
+        upload survives (it holds the dataset, not the model)."""
+        if not variables_seeds:
+            raise ValueError("refresh needs at least one variables pytree")
+        if self._multi:
+            variables_seeds = [replicate(v, self.mesh)
+                               for v in variables_seeds]
+        t = self.tenant(name)
+        with self._lock:
+            t.variables_seeds = list(variables_seeds)
+            t.scores = {}
+
     def tenant(self, name: str) -> Tenant:
         try:
             return self.tenants[name]

@@ -1,0 +1,459 @@
+"""ServeFleet: a replicated serve pod with bounded-restart supervision.
+
+``serve.replicas > 1`` turns ``cli serve`` into this supervisor: N serve
+replicas as child processes (each its own mesh + auto-picked port, each
+knowing its index via ``DDT_SERVE_REPLICA``), fronted by the health-aware
+router (``router.py``) — the one address clients keep while replicas die,
+wedge, and come back.
+
+The machinery is the elastic pod's (``resilience/elastic.py``), re-aimed at
+serving: the same ``RestartBudget`` bounds respawns with exponential
+backoff, the same ``classify_rc`` names exits, the same jax-free
+``JsonlLogger`` lands every decision in the run's metrics JSONL — as
+``{"kind": "serve_fleet"}`` (fleet lifecycle) and ``{"kind":
+"replica_event"}`` (per-replica deaths/wedges/respawns) records the
+postmortem timeline and ``run_monitor`` replay. Unlike the elastic
+supervisor, replicas are independent (no collective to tear), so one
+death never restarts the others — the router routes around it while the
+supervisor respawns it in place, on the SAME port (clients of the router
+never see the churn).
+
+Failure paths:
+
+* **replica death** (SIGKILL, OOM, crash): the supervision loop sees the
+  exit, the router's in-flight requests fail over to the survivors
+  (idempotent replay), and the replica respawns on its port — budgeted.
+* **wedged replica**: a dispatch in flight past ``serve.dispatch_stall_s``
+  makes the replica's own /healthz critical; the health poller stops
+  routing there, SIGTERMs it (bounded by ``elastic.reap_timeout_s``, then
+  SIGKILL), and respawns it.
+* **fleet SIGTERM**: admission stops at the router, replicas drain
+  (their own SIGTERM contract), and the fleet exits 75 — the same
+  preemption vocabulary as every other command.
+
+Zero-downtime refresh: ``POST /v1/refresh`` at the router (or the
+``serve.refresh_poll_s`` watcher here) rolls the new checkpoint across
+replicas ONE at a time; each installs atomically between dispatches
+(``ServeService.refresh``), so capacity never drops and every response is
+bit-identical to exactly one of {old, new}.
+
+All lineage stays at attempt 0: replica respawns are tracked by their own
+generation counter, not lineage attempts — a serving fleet's churn is
+steady-state, not a run-level recovery chain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ..obs import lineage
+from ..obs.slo import SloEngine
+from ..resilience.elastic import (EXIT_PREEMPTED, JsonlLogger, RestartBudget,
+                                  classify_rc, free_port)
+from .router import Replica, ServeRouter
+
+#: A serve child's fleet index — set by the supervisor, read by the fault
+#: injector (replica-targeted plans) and the replica's own stats records.
+REPLICA_ENV = "DDT_SERVE_REPLICA"
+
+
+def fleet_dir(checkpoint_dir: str) -> str:
+    """Fleet control-plane directory (child logs, per-replica heartbeat
+    roots), sibling of the checkpoint dir like ``_elastic``."""
+    return f"{checkpoint_dir}_fleet"
+
+
+def discover_steps(directory: str) -> list[int]:
+    """Durable checkpoint steps under ``directory``, jax-free: Orbax steps
+    are numeric dirnames; tier steps are ``<dir>_tiered/step_N`` dirs whose
+    every rank named by the rank-0 marker has its own promotion marker
+    (the same discipline as ``checkpoint.tier_steps``, duplicated here
+    because ``checkpoint.py`` imports jax and the supervisor must not).
+    Used by the refresh watchers to spot a newer model."""
+    steps: set[int] = set()
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in names:
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            steps.add(int(name))
+    tiered = f"{os.path.abspath(directory)}_tiered"
+    try:
+        tier_names = os.listdir(tiered)
+    except OSError:
+        tier_names = []
+    for name in tier_names:
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            continue
+        sdir = os.path.join(tiered, name)
+        try:
+            with open(os.path.join(sdir, "promoted.rank0.json")) as fh:
+                world = int(json.load(fh).get("world", 1))
+        except (OSError, ValueError):
+            continue
+        if all(os.path.exists(os.path.join(sdir, f"promoted.rank{r}.json"))
+               for r in range(world)):
+            steps.add(step)
+    return sorted(steps)
+
+
+class ServeFleet:
+    """Bounded-restart supervisor over N serve replicas + the router.
+
+    ``spawn(index, generation)`` (injectable for tests) must return a
+    ``subprocess.Popen``-like object; ``fault_env(index, generation)``
+    returns extra child environment — generation-0 children inherit the
+    operator's ``DDT_FAULT_PLAN``, respawns never do (a replica-killing
+    plan re-arming on every respawn would burn the budget on one fault).
+    """
+
+    def __init__(self, cfg, *, config_path: str | None = None,
+                 overrides: list[str] | None = None, logger=None,
+                 spawn=None, fault_env=None):
+        self.cfg = cfg
+        self.config_path = config_path
+        self.overrides = list(overrides or [])
+        self.logger = logger
+        self._spawn = spawn or self._spawn_local
+        self._fault_env = fault_env
+        sv = cfg.serve
+        self.n = int(sv.replicas)
+        self.budget = RestartBudget(int(cfg.elastic.max_restarts),
+                                    float(cfg.elastic.backoff_s))
+        self.reap_timeout_s = float(cfg.elastic.reap_timeout_s)
+        self.run_id = (os.environ.get(lineage.RUN_ID_ENV)
+                       or lineage.new_run_id())
+        self._lineage = lineage.install(
+            lineage.Lineage(run_id=self.run_id, attempt=0))
+        self.log_dir = fleet_dir(cfg.train.checkpoint_dir)
+        # One port per replica slot, picked once and REUSED across respawns:
+        # the router's replica table never changes, so a respawn is
+        # invisible to routing the moment the replica's /healthz answers.
+        self.ports = [free_port() for _ in range(self.n)]
+        self.replicas = [Replica(i, sv.host, p,
+                                 breaker_failures=sv.breaker_failures,
+                                 breaker_reset_s=sv.breaker_reset_s)
+                         for i, p in enumerate(self.ports)]
+        self.router = ServeRouter(
+            self.replicas, host=sv.host, port=int(sv.router_port),
+            retries=int(sv.route_retries), hedge_ms=sv.hedge_ms,
+            # Router deadline strictly wider than the replicas' own
+            # request bound: a slow-but-legal dispatch must time out THERE
+            # (429/504 from the replica), never as a router transport kill.
+            timeout_s=float(sv.request_timeout_s) + 5.0,
+            idem_cache=int(sv.idempotency_cache),
+            retry_after_s=float(sv.retry_after_s), logger=logger)
+        self.procs: list = [None] * self.n
+        self.gens = [0] * self.n
+        self.events: list[dict] = []
+        self.slo = SloEngine.from_cfg(cfg, logger=logger)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._preempted = False
+        self._give_up = False
+        self._threads: list[threading.Thread] = []
+        self._stats_seq = 0
+
+    # ------------------------------------------------------------- records
+
+    def _event(self, event: str, **fields) -> None:
+        rec = {"event": event, "replicas": self.n, **fields}
+        self.events.append(rec)
+        if self.logger is not None:
+            self.logger.log("serve_fleet", **rec)
+
+    def _replica_event(self, index: int, event: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.log("replica_event", replica=index, event=event,
+                            **fields)
+
+    # ------------------------------------------------------------- spawning
+
+    def _child_argv(self, index: int) -> list[str]:
+        argv = [sys.executable, "-m", "data_diet_distributed_tpu.cli",
+                "serve"]
+        if self.config_path:
+            argv += ["--config", self.config_path]
+        argv += self.overrides
+        # Appended LAST so the fleet's geometry wins over the operator's:
+        # one replica per child (no recursion), its own port and heartbeat
+        # root (replicas are all rank 0 — a shared heartbeat file would
+        # make them overwrite each other), refresh rolled by the FLEET
+        # (a per-replica watcher racing the roll could tear the
+        # one-at-a-time discipline), and no elastic supervision inside.
+        argv += [f"serve.port={self.ports[index]}",
+                 f"serve.host={self.cfg.serve.host}",
+                 "serve.replicas=1",
+                 "serve.refresh_poll_s=null",
+                 "elastic.enabled=false",
+                 f"obs.heartbeat_dir={os.path.join(self.log_dir, f'hb_r{index}')}"]
+        return argv
+
+    def _spawn_local(self, index: int, generation: int):
+        env = dict(os.environ)
+        env[REPLICA_ENV] = str(index)
+        # Lineage attempt stays 0 (see module docstring); world = fleet size.
+        env.update(lineage.child_env(self.run_id, 0, self.n))
+        if generation > 0:
+            env.pop("DDT_FAULT_PLAN", None)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+        if self._fault_env is not None:
+            env.update(self._fault_env(index, generation) or {})
+        os.makedirs(self.log_dir, exist_ok=True)
+        log_path = os.path.join(self.log_dir,
+                                f"replica{index}_g{generation}.log")
+        log_fh = open(log_path, "ab")
+        proc = subprocess.Popen(self._child_argv(index), stdout=log_fh,
+                                stderr=subprocess.STDOUT, env=env)
+        proc._ddt_log_path = log_path       # type: ignore[attr-defined]
+        proc._ddt_log_fh = log_fh           # type: ignore[attr-defined]
+        return proc
+
+    def _tail(self, index: int, generation: int) -> str:
+        path = os.path.join(self.log_dir,
+                            f"replica{index}_g{generation}.log")
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                fh.seek(max(0, fh.tell() - 2000))
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    # ----------------------------------------------------------- respawning
+
+    def _replace(self, index: int, proc, *, cause: str,
+                 term_first: bool) -> None:
+        """Reap one replica and respawn it in place (budgeted). No-ops when
+        another thread already replaced ``proc`` — the health poller and
+        the supervision loop can both spot the same casualty."""
+        with self._lock:
+            if self.procs[index] is not proc or self._stop.is_set():
+                return
+            self.router.set_health(index, False)
+            if term_first and proc.poll() is None:
+                proc.terminate()
+            try:
+                proc.wait(timeout=self.reap_timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            rc = proc.returncode
+            fh = getattr(proc, "_ddt_log_fh", None)
+            if fh is not None:
+                fh.close()
+            gen = self.gens[index]
+            died_by_signal = rc is not None and rc < 0
+            self._replica_event(
+                index,
+                "died" if (died_by_signal and not term_first) else
+                ("wedged_reaped" if cause == "wedged" else "exited"),
+                cause=cause, rc=rc,
+                signal=(-rc if died_by_signal else None),
+                exit_class=(classify_rc(rc) if not died_by_signal else None),
+                generation=gen)
+            if self.budget.exhausted():
+                print(f"[fleet] replica {index} g{gen} rc={rc} tail:\n"
+                      f"{self._tail(index, gen)}", file=sys.stderr,
+                      flush=True)
+                self._give_up = True
+                self._stop.set()
+                return
+            backoff = self.budget.spend(gen)
+            if backoff:
+                time.sleep(backoff)
+            self.gens[index] += 1
+            self.replicas[index].generation = self.gens[index]
+            self.procs[index] = self._spawn(index, self.gens[index])
+            self._replica_event(index, "respawn",
+                                generation=self.gens[index],
+                                port=self.ports[index],
+                                restarts_left=self.budget.left)
+
+    # -------------------------------------------------------------- polling
+
+    def _poll_health(self, rep: Replica) -> dict | None:
+        """One /healthz read; None = unreachable (booting or dead)."""
+        url = f"http://{rep.host}:{rep.port}/healthz"
+        timeout = max(1.0, float(self.cfg.serve.health_poll_s) * 2)
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as err:
+            # 503 IS an answer (critical verdict rides the body).
+            try:
+                return json.loads(err.read().decode())
+            except ValueError:
+                return {"status": "critical",
+                        "reasons": [f"http {err.code}"]}
+        except (OSError, ValueError):
+            return None
+
+    def _health_loop(self) -> None:
+        poll = float(self.cfg.serve.health_poll_s)
+        while not self._stop.wait(poll):
+            with self._lock:
+                snapshot = list(enumerate(self.procs))
+            for index, proc in snapshot:
+                if self._stop.is_set():
+                    return
+                if proc is None or proc.poll() is not None:
+                    self.router.set_health(index, False)
+                    continue
+                verdict = self._poll_health(self.replicas[index])
+                if verdict is None:
+                    self.router.set_health(index, False)
+                elif verdict.get("status") == "critical":
+                    # The replica's own watchdog verdict (wedged dispatcher
+                    # past serve.dispatch_stall_s, stale heartbeat, …):
+                    # stop routing there, drain it, respawn it.
+                    self.router.set_health(index, False, verdict)
+                    self._replica_event(index, "wedged",
+                                        reasons=verdict.get("reasons"),
+                                        generation=self.gens[index])
+                    self._replace(index, proc, cause="wedged",
+                                  term_first=True)
+                else:
+                    self.router.set_health(index, True, verdict)
+
+    def _stats_loop(self) -> None:
+        every = float(self.cfg.serve.stats_every_s)
+        while not self._stop.wait(every):
+            self._emit_stats()
+
+    def _emit_stats(self) -> None:
+        stats = self.router.stats()
+        self._stats_seq += 1
+        self._event("stats", seq=self._stats_seq, **stats)
+        if self.slo is not None:
+            self.slo.check_fleet(
+                point=self._stats_seq,
+                p95_ms=(stats["p95_ms"] if stats["proxied"] else None),
+                available_frac=stats["available"] / max(1, self.n),
+                logger=self.logger)
+
+    def _refresh_watch_loop(self) -> None:
+        poll = float(self.cfg.serve.refresh_poll_s)
+        source = (self.cfg.serve.refresh_from
+                  or self.cfg.train.checkpoint_dir)
+        installed: int | None = None
+        while not self._stop.wait(poll):
+            steps = discover_steps(source)
+            if not steps:
+                continue
+            newest = steps[-1]
+            if installed is not None and newest <= installed:
+                continue
+            code, _ = self.router.roll_refresh_direct({"step": newest})
+            if code == 200:
+                installed = newest
+
+    # ------------------------------------------------------------------ run
+
+    def _on_signal(self, signum, frame) -> None:   # noqa: ARG002
+        self._preempted = True
+        self._stop.set()
+
+    def run(self) -> int:
+        signal.signal(signal.SIGTERM, self._on_signal)
+        signal.signal(signal.SIGINT, self._on_signal)
+        self._event("supervise", restarts=self.budget.left,
+                    ports=list(self.ports))
+        with self._lock:
+            for index in range(self.n):
+                self.procs[index] = self._spawn(index, 0)
+                self._replica_event(index, "spawn", generation=0,
+                                    port=self.ports[index])
+        # Unroutable until their first reachable /healthz — the router must
+        # not send real traffic into a replica that is still compiling.
+        for rep in self.replicas:
+            rep.healthy = False
+        port = self.router.bind()
+        self._event("launch", router_port=port)
+        print(f"[fleet] router on http://{self.cfg.serve.host}:{port} "
+              f"({self.n} replicas, ports {self.ports})", flush=True)
+        self._threads = [
+            threading.Thread(target=self._health_loop,
+                             name="fleet-health", daemon=True),
+            threading.Thread(target=self._stats_loop,
+                             name="fleet-stats", daemon=True)]
+        if self.cfg.serve.refresh_poll_s is not None:
+            self._threads.append(
+                threading.Thread(target=self._refresh_watch_loop,
+                                 name="fleet-refresh", daemon=True))
+        for t in self._threads:
+            t.start()
+        while not self._stop.is_set():
+            with self._lock:
+                snapshot = list(enumerate(self.procs))
+            for index, proc in snapshot:
+                if proc is not None and proc.poll() is not None:
+                    self._replace(index, proc, cause="exit",
+                                  term_first=False)
+            self._stop.wait(0.2)
+        return self._shutdown()
+
+    def _shutdown(self) -> int:
+        self.router.stop_admission()
+        self._event("drain", preempted=self._preempted,
+                    give_up=self._give_up)
+        for t in self._threads:
+            t.join(timeout=5)
+        with self._lock:
+            procs = list(self.procs)
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        rcs = []
+        deadline = time.monotonic() + float(self.cfg.serve.drain_timeout_s) + 5
+        for proc in procs:
+            if proc is None:
+                rcs.append(None)
+                continue
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            rcs.append(proc.returncode)
+            fh = getattr(proc, "_ddt_log_fh", None)
+            if fh is not None:
+                fh.close()
+        self._emit_stats()
+        self.router.stop()
+        if self._give_up:
+            self._event("give_up", rcs=rcs)
+            return max((rc for rc in rcs if rc and rc > 0), default=1)
+        self._event("preempted_exit" if self._preempted else "complete",
+                    rcs=rcs)
+        return EXIT_PREEMPTED if self._preempted else 0
+
+    # ------------------------------------------------------------- terminal
+
+    def lineage_block(self) -> dict:
+        """The fleet's terminal summary (the supervisor run_summary's
+        lineage twin): replica count, per-slot generations (how many times
+        each was respawned), and the budget left."""
+        return {"run_id": self.run_id, "replicas": self.n,
+                "generations": list(self.gens),
+                "respawns": sum(self.gens),
+                "restarts_left": self.budget.left}
+
+    def exit_class(self, rc: int) -> str:
+        return classify_rc(rc)

@@ -1,0 +1,622 @@
+"""Health-aware HTTP router over a fleet of serve replicas.
+
+The single-process server (``server.py``) dies with its host; the fleet
+(``fleet.py``) runs N of them — and this module is the one address clients
+keep: a reverse proxy that load-balances ``/v1/score`` ``/v1/rank``
+``/v1/topk`` across the replicas the health poller says are alive, and
+turns a replica death into a retry instead of a client-visible failure.
+
+Routing policy, in order:
+
+* **candidates** — healthy (fleet health poller verdict) AND allowed by the
+  replica's circuit breaker, rotated round-robin; no candidate -> 503 with
+  ``Retry-After`` (the same backpressure vocabulary the replicas speak).
+* **retry only what is idempotent** — a transport failure (connection
+  refused/reset, torn response: the signature of a SIGKILLed replica) is
+  retried on the next candidate ONLY for requests that are safe to replay:
+  ``GET`` requests, and ``POST`` requests carrying an ``Idempotency-Key``
+  header. A keyless POST gets an honest 502 — the router cannot know
+  whether the dead replica dispatched it.
+* **idempotency replay cache** — responses to keyed requests are cached
+  (bounded LRU, ``serve.idempotency_cache`` entries) and the key is echoed
+  back; a client retry of an already-answered request replays the cached
+  response (``X-Idempotent-Replay: 1``) instead of double-dispatching, and
+  concurrent duplicates single-flight behind the first.
+* **circuit breaking** — ``breaker_failures`` consecutive transport
+  failures open a replica's breaker; after ``breaker_reset_s`` one probe
+  request is let through (half-open) and its success closes the circuit.
+  Transitions land as ``{"kind": "replica_event"}`` records.
+* **hedging** (optional) — an idempotent request still unanswered after
+  ``hedge_ms`` is duplicated to a second replica; first answer wins and
+  the loser's connection is closed.
+
+``/healthz`` and ``/status`` are answered by the router itself (fleet
+view); ``POST /v1/refresh`` triggers a one-replica-at-a-time refresh roll.
+The router is deliberately jax-free: it lives in the fleet supervisor
+process, which must keep running while replicas claim and release backends.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+#: Transport-level failures: the request may not have reached the replica
+#: (or its answer died with it). These — and only these — count against the
+#: breaker and are retry-eligible. HTTP error STATUSES (429, 400, 409…) are
+#: the replica speaking and pass through untouched.
+TRANSPORT_ERRORS = (OSError, http.client.HTTPException)
+
+
+def percentile(values, q: float) -> float:
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return float(vals[idx])
+
+
+class CircuitBreaker:
+    """Per-replica circuit: closed -> (N consecutive transport failures) ->
+    open -> (reset_s elapsed) -> half-open, one probe in flight -> closed on
+    its success, re-open on its failure. ``allowing()`` is the non-mutating
+    candidate filter; ``acquire()`` takes the half-open probe slot and must
+    be paired with ``success()``/``failure()``."""
+
+    def __init__(self, failures: int, reset_s: float):
+        self.threshold = max(1, int(failures))
+        self.reset_s = float(reset_s)
+        self.state = "closed"
+        self._consecutive = 0
+        self._opened_mono: float | None = None
+        self._probing = False
+        self._lock = threading.Lock()
+
+    def _maybe_half_open(self, now: float) -> None:
+        if (self.state == "open" and self._opened_mono is not None
+                and now - self._opened_mono >= self.reset_s):
+            self.state = "half_open"
+            self._probing = False
+
+    def allowing(self) -> bool:
+        with self._lock:
+            self._maybe_half_open(time.monotonic())
+            if self.state == "closed":
+                return True
+            return self.state == "half_open" and not self._probing
+
+    def acquire(self) -> bool:
+        with self._lock:
+            self._maybe_half_open(time.monotonic())
+            if self.state == "closed":
+                return True
+            if self.state == "half_open" and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def success(self) -> bool:
+        """Returns True when this success CLOSED a previously open circuit
+        (so the caller can log the transition once)."""
+        with self._lock:
+            reopened = self.state != "closed"
+            self.state = "closed"
+            self._consecutive = 0
+            self._probing = False
+            return reopened
+
+    def failure(self) -> bool:
+        """Returns True when this failure OPENED the circuit."""
+        with self._lock:
+            self._probing = False
+            self._consecutive += 1
+            if self.state == "half_open" or (
+                    self.state == "closed"
+                    and self._consecutive >= self.threshold):
+                self.state = "open"
+                self._opened_mono = time.monotonic()
+                return True
+            if self.state == "open":
+                self._opened_mono = time.monotonic()
+            return False
+
+
+class Replica:
+    """One backend's routing view: address, the health poller's verdict,
+    and the circuit breaker. ``healthy`` starts True (a freshly constructed
+    router with no poller — the unit tests — routes everywhere); the fleet
+    marks replicas down until their first reachable /healthz."""
+
+    def __init__(self, index: int, host: str, port: int, *,
+                 breaker_failures: int = 3, breaker_reset_s: float = 2.0):
+        self.index = int(index)
+        self.host = host
+        self.port = int(port)
+        self.healthy = True
+        self.health: dict = {}
+        self.generation = 0
+        self.breaker = CircuitBreaker(breaker_failures, breaker_reset_s)
+
+    def routable(self) -> bool:
+        return self.healthy and self.breaker.allowing()
+
+    def view(self) -> dict:
+        return {"replica": self.index, "port": self.port,
+                "healthy": self.healthy, "breaker": self.breaker.state,
+                "generation": self.generation,
+                "status": self.health.get("status")}
+
+
+class _IdemEntry:
+    __slots__ = ("event", "result")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None   # (status, body, headers) once cached
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # noqa: A002 — silence stderr chatter
+        pass
+
+    @property
+    def router(self) -> "ServeRouter":
+        return self.server.router   # type: ignore[attr-defined]
+
+    def _reply(self, code: int, payload: dict | bytes,
+               headers: dict | None = None) -> None:
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        sent = {k.lower() for k in (headers or {})}
+        if "content-type" not in sent:
+            self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):   # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            verdict = self.router.health()
+            self._reply(503 if verdict["status"] == "critical" else 200,
+                        verdict)
+            return
+        if path == "/status":
+            self._reply(200, self.router.status())
+            return
+        code, body, headers = self.router.handle(
+            "GET", self.path, b"", dict(self.headers))
+        self._reply(code, body, headers)
+
+    def do_POST(self):   # noqa: N802
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        path = self.path.split("?", 1)[0]
+        if path == "/v1/refresh":
+            try:
+                spec = json.loads(body.decode() or "{}")
+            except ValueError:
+                self._reply(400, {"error": "body is not JSON"})
+                return
+            code, payload = self.router.roll_refresh(spec)
+            self._reply(code, payload)
+            return
+        code, out, headers = self.router.handle(
+            "POST", self.path, body, dict(self.headers))
+        self._reply(code, out, headers)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ServeRouter:
+    def __init__(self, replicas: list[Replica], *, host: str = "127.0.0.1",
+                 port: int = 0, retries: int = 2, hedge_ms: float | None = None,
+                 timeout_s: float = 60.0, idem_cache: int = 256,
+                 retry_after_s: float = 1.0, logger=None, on_refresh=None):
+        self.replicas = list(replicas)
+        self.host = host
+        self.port = int(port)
+        self.retries = max(0, int(retries))
+        self.hedge_ms = hedge_ms
+        self.timeout_s = float(timeout_s)
+        self.retry_after_s = float(retry_after_s)
+        self.logger = logger
+        # Refresh-roll delegate: fleet injects its own roll (which knows the
+        # replica generation map); None = the router's built-in roll.
+        self.on_refresh = on_refresh
+        self._idem: OrderedDict[str, _IdemEntry] = OrderedDict()
+        self._idem_cap = max(1, int(idem_cache))
+        self._idem_lock = threading.Lock()
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._roll_lock = threading.Lock()
+        self._draining = False
+        self._latencies_ms: deque = deque(maxlen=4096)
+        self._stats_lock = threading.Lock()
+        self.counters = {"requests": 0, "proxied": 0, "retries": 0,
+                         "replays": 0, "hedges": 0, "hedge_wins": 0,
+                         "no_replica": 0, "transport_failures": 0}
+        self._httpd: _Server | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def bind(self) -> int:
+        self._httpd = _Server((self.host, self.port), _RouterHandler)
+        self._httpd.router = self   # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-router", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def stop_admission(self) -> None:
+        """Drain mode: every proxy request is refused with 503 (in-flight
+        ones finish); /healthz goes critical so external pollers stop."""
+        self._draining = True
+
+    # -------------------------------------------------------------- plumbing
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.counters[key] += n
+
+    def _event(self, replica: int, event: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.log("replica_event", replica=replica, event=event,
+                            **fields)
+
+    def set_health(self, index: int, healthy: bool,
+                   verdict: dict | None = None) -> None:
+        rep = self.replicas[index]
+        rep.healthy = bool(healthy)
+        if verdict is not None:
+            rep.health = verdict
+
+    def _candidates(self, exclude: set[int]) -> list[Replica]:
+        with self._rr_lock:
+            start = self._rr
+            self._rr += 1
+        n = len(self.replicas)
+        order = [self.replicas[(start + i) % n] for i in range(n)]
+        return [r for r in order
+                if r.index not in exclude and r.routable()]
+
+    def _proxy_once(self, rep: Replica, method: str, path: str, body: bytes,
+                    headers: dict, deadline: float, conns: list | None = None):
+        budget = max(0.05, deadline - time.monotonic())
+        conn = http.client.HTTPConnection(rep.host, rep.port, timeout=budget)
+        if conns is not None:
+            conns.append(conn)
+        try:
+            fwd = {k: v for k, v in headers.items()
+                   if k.lower() in ("content-type", "idempotency-key")}
+            if body and "content-type" not in {k.lower() for k in fwd}:
+                fwd["Content-Type"] = "application/json"
+            conn.request(method, path, body=body or None, headers=fwd)
+            resp = conn.getresponse()
+            data = resp.read()
+            out_headers = {}
+            for key in ("Content-Type", "Retry-After"):
+                val = resp.getheader(key)
+                if val is not None:
+                    out_headers[key] = val
+            return resp.status, data, out_headers
+        finally:
+            conn.close()
+
+    def _note_success(self, rep: Replica) -> None:
+        if rep.breaker.success():
+            self._event(rep.index, "breaker_close", port=rep.port)
+
+    def _note_failure(self, rep: Replica, exc: BaseException) -> None:
+        self._count("transport_failures")
+        if rep.breaker.failure():
+            self._event(rep.index, "breaker_open", port=rep.port,
+                        error=repr(exc)[:200])
+
+    # ----------------------------------------------------------- idempotency
+
+    def _idem_begin(self, key: str):
+        """(entry, owner): owner dispatches and publishes; a non-owner waits
+        on the entry and replays its cached response."""
+        with self._idem_lock:
+            entry = self._idem.get(key)
+            if entry is not None:
+                self._idem.move_to_end(key)
+                return entry, False
+            entry = _IdemEntry()
+            self._idem[key] = entry
+            while len(self._idem) > self._idem_cap:
+                self._idem.popitem(last=False)
+            return entry, True
+
+    def _idem_publish(self, key: str, entry: _IdemEntry, result) -> None:
+        entry.result = result
+        entry.event.set()
+        if result is None:
+            # A failed dispatch must not poison the key: drop the entry so
+            # the client's next retry becomes a fresh owner.
+            with self._idem_lock:
+                if self._idem.get(key) is entry:
+                    del self._idem[key]
+
+    # --------------------------------------------------------------- routing
+
+    def handle(self, method: str, path: str, body: bytes,
+               headers: dict) -> tuple[int, bytes | dict, dict]:
+        """Route one client request; returns (status, body, headers)."""
+        self._count("requests")
+        if self._draining:
+            return 503, {"error": "router draining"}, {
+                "Retry-After": f"{self.retry_after_s:g}"}
+        idem_key = next((v for k, v in headers.items()
+                         if k.lower() == "idempotency-key"), None)
+        idempotent = method == "GET" or idem_key is not None
+        echo = {} if idem_key is None else {"Idempotency-Key": idem_key}
+        entry = None
+        if idem_key is not None:
+            entry, owner = self._idem_begin(idem_key)
+            if not owner:
+                budget = max(0.05, self.timeout_s)
+                if entry.event.wait(timeout=budget) and entry.result:
+                    status, data, hdrs = entry.result
+                    self._count("replays")
+                    return status, data, dict(hdrs, **echo,
+                                              **{"X-Idempotent-Replay": "1"})
+                # Original owner failed (or timed out): dispatch ourselves,
+                # publishing into the same entry on success.
+        t0 = time.monotonic()
+        deadline = t0 + self.timeout_s
+        try:
+            result = self._dispatch(method, path, body, headers, idempotent,
+                                    deadline)
+        except BaseException:
+            if entry is not None:
+                self._idem_publish(idem_key, entry, None)
+            raise
+        status, data, hdrs, rep = result
+        if rep is not None:
+            self._with_latency((time.monotonic() - t0) * 1000.0)
+            hdrs = dict(hdrs, **{"X-Served-By": str(rep.index)})
+        if entry is not None:
+            self._idem_publish(idem_key, entry,
+                               (status, data, hdrs) if status == 200 else None)
+        return status, data, dict(hdrs, **echo)
+
+    def _with_latency(self, ms: float) -> None:
+        with self._stats_lock:
+            self._latencies_ms.append(ms)
+
+    def _dispatch(self, method, path, body, headers, idempotent, deadline):
+        """(status, body, headers, replica-or-None) after retry/hedge."""
+        attempted: set[int] = set()
+        last_exc: BaseException | None = None
+        budget_tries = (self.retries + 1) if idempotent else 1
+        tried = 0
+        while tried < budget_tries and time.monotonic() < deadline:
+            reps = self._candidates(attempted)
+            if not reps:
+                break
+            if (self.hedge_ms is not None and idempotent and len(reps) >= 2
+                    and tried == 0):
+                result = self._hedged(reps, method, path, body, headers,
+                                      deadline, attempted)
+                if result is not None:
+                    return result
+                tried += 2
+                self._count("retries")
+                continue
+            rep = next((r for r in reps if r.breaker.acquire()), None)
+            if rep is None:
+                break
+            tried += 1
+            try:
+                status, data, hdrs = self._proxy_once(
+                    rep, method, path, body, headers, deadline)
+            except TRANSPORT_ERRORS as exc:
+                last_exc = exc
+                self._note_failure(rep, exc)
+                attempted.add(rep.index)
+                if idempotent:
+                    self._count("retries")
+                    continue
+                return 502, {"error": "upstream transport failure on a "
+                                      "non-idempotent request (no "
+                                      "Idempotency-Key); not retried",
+                             "detail": repr(exc)[:200]}, {}, None
+            self._note_success(rep)
+            self._count("proxied")
+            return status, data, hdrs, rep
+        if last_exc is not None and time.monotonic() >= deadline:
+            return 504, {"error": "deadline exhausted retrying",
+                         "detail": repr(last_exc)[:200]}, {}, None
+        self._count("no_replica")
+        return 503, {"error": "no routable replica",
+                     "detail": (repr(last_exc)[:200] if last_exc else None)}, \
+            {"Retry-After": f"{self.retry_after_s:g}"}, None
+
+    def _hedged(self, reps, method, path, body, headers, deadline, attempted):
+        """Primary + one hedge: first success wins, the loser's connection
+        is closed (its blocked read tears down, the thread exits). Returns
+        the winning (status, body, headers, replica) or None when both
+        attempts fail (caller falls back to the sequential loop)."""
+        primary, backup = reps[0], reps[1]
+        lock = threading.Lock()
+        done = threading.Event()
+        state: dict = {"result": None, "finished": 0, "launched": 1}
+        all_conns: dict[int, list] = {primary.index: [], backup.index: []}
+
+        def attempt(rep: Replica, is_hedge: bool) -> None:
+            if not rep.breaker.acquire():
+                with lock:
+                    state["finished"] += 1
+                    if state["finished"] >= state["launched"]:
+                        done.set()
+                return
+            try:
+                status, data, hdrs = self._proxy_once(
+                    rep, method, path, body, headers, deadline,
+                    conns=all_conns[rep.index])
+            except TRANSPORT_ERRORS as exc:
+                self._note_failure(rep, exc)
+                with lock:
+                    attempted.add(rep.index)
+                    state["finished"] += 1
+                    if state["finished"] >= state["launched"]:
+                        done.set()
+                return
+            self._note_success(rep)
+            with lock:
+                state["finished"] += 1
+                if state["result"] is None:
+                    state["result"] = (status, data, hdrs, rep, is_hedge)
+                    done.set()
+                    # Cancel the loser: closing its socket unblocks its read.
+                    for idx, conns in all_conns.items():
+                        if idx != rep.index:
+                            for c in conns:
+                                try:
+                                    c.close()
+                                except OSError:
+                                    pass
+
+        t1 = threading.Thread(target=attempt, args=(primary, False),
+                              daemon=True)
+        t1.start()
+        if not done.wait(timeout=self.hedge_ms / 1000.0):
+            with lock:
+                state["launched"] = 2
+            self._count("hedges")
+            t2 = threading.Thread(target=attempt, args=(backup, True),
+                                  daemon=True)
+            t2.start()
+        done.wait(timeout=max(0.05, deadline - time.monotonic()))
+        with lock:
+            result = state["result"]
+        if result is None:
+            return None
+        status, data, hdrs, rep, was_hedge = result
+        if was_hedge:
+            self._count("hedge_wins")
+        self._count("proxied")
+        return status, data, hdrs, rep
+
+    # -------------------------------------------------------------- refresh
+
+    def roll_refresh(self, spec: dict) -> tuple[int, dict]:
+        """Zero-downtime model refresh: POST /v1/refresh to one replica at a
+        time (each installs atomically between dispatches, serving the old
+        model until the swap — capacity never drops). Aborts on the first
+        rejection, old model still serving everywhere not yet rolled."""
+        if self.on_refresh is not None:
+            return self.on_refresh(spec)
+        return self.roll_refresh_direct(spec)
+
+    def roll_refresh_direct(self, spec: dict) -> tuple[int, dict]:
+        if not self._roll_lock.acquire(blocking=False):
+            return 409, {"error": "a refresh roll is already in flight"}
+        try:
+            if self.logger is not None:
+                self.logger.log("model_refresh", status="roll_started",
+                                tenant=spec.get("tenant"),
+                                step=spec.get("step"))
+            results = []
+            body = json.dumps(spec).encode()
+            for rep in self.replicas:
+                if not rep.healthy:
+                    # An unroutable replica cannot install; rolling past it
+                    # would leave a torn fleet once it heals. Abort loudly.
+                    results.append({"replica": rep.index,
+                                    "status": "unreachable"})
+                    return self._roll_verdict(409, spec, results)
+                try:
+                    status, data, _ = self._proxy_once(
+                        rep, "POST", "/v1/refresh", body,
+                        {"Content-Type": "application/json"},
+                        time.monotonic() + self.timeout_s)
+                except TRANSPORT_ERRORS as exc:
+                    self._note_failure(rep, exc)
+                    results.append({"replica": rep.index,
+                                    "status": "transport_error",
+                                    "detail": repr(exc)[:200]})
+                    return self._roll_verdict(502, spec, results)
+                try:
+                    payload = json.loads(data.decode() or "{}")
+                except ValueError:
+                    payload = {}
+                results.append({"replica": rep.index, "code": status,
+                                **payload})
+                if status != 200:
+                    return self._roll_verdict(status, spec, results)
+            return self._roll_verdict(200, spec, results)
+        finally:
+            self._roll_lock.release()
+
+    def _roll_verdict(self, code: int, spec: dict,
+                      results: list) -> tuple[int, dict]:
+        ok = code == 200
+        if self.logger is not None:
+            self.logger.log("model_refresh",
+                            status="roll_complete" if ok else "roll_aborted",
+                            tenant=spec.get("tenant"), step=spec.get("step"),
+                            replicas=len(results))
+        return code, {"status": "rolled" if ok else "roll_aborted",
+                      "replicas": results}
+
+    # ---------------------------------------------------------------- views
+
+    def p95_ms(self) -> float:
+        with self._stats_lock:
+            return percentile(self._latencies_ms, 0.95)
+
+    def available(self) -> int:
+        return sum(r.routable() for r in self.replicas)
+
+    def health(self) -> dict:
+        avail = self.available()
+        if self._draining:
+            status, reasons = "critical", ["router draining"]
+        elif avail == len(self.replicas):
+            status, reasons = "ok", []
+        else:
+            status = "critical" if avail == 0 else "degraded"
+            reasons = [f"{len(self.replicas) - avail} of "
+                       f"{len(self.replicas)} replicas unroutable"]
+        return {"status": status, "available": avail,
+                "replicas": [r.view() for r in self.replicas],
+                "draining": self._draining, "reasons": reasons}
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            counters = dict(self.counters)
+            lat = list(self._latencies_ms)
+        return {**counters, "available": self.available(),
+                "replicas": len(self.replicas),
+                "p50_ms": round(percentile(lat, 0.50), 3),
+                "p95_ms": round(percentile(lat, 0.95), 3)}
+
+    def status(self) -> dict:
+        return {"router": self.stats(),
+                "replicas": [r.view() for r in self.replicas]}

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from urllib.parse import parse_qs, urlsplit
@@ -93,12 +94,19 @@ class _ServeHandler(obs_server._Handler):
                     code, payload, headers = service.handle_score(body)
                 elif path == "/v1/rank":
                     code, payload, headers = service.handle_rank(body)
+                elif path == "/v1/refresh":
+                    code, payload, headers = service.handle_refresh(body)
                 else:
                     code, headers = 404, {}
                     payload = {"error": f"unknown path {path!r}",
                                "endpoints": owner.endpoint_names()}
         except Exception as exc:   # noqa: BLE001 — a failure is a payload
             code, payload, headers = 500, {"error": repr(exc)[:300]}, {}
+        # An Idempotency-Key echoes on every response (the fleet router adds
+        # its replay semantics on top; direct clients get the echo too).
+        idem = self.headers.get("Idempotency-Key")
+        if idem:
+            headers = dict(headers, **{"Idempotency-Key": idem})
         self._respond(code, json.dumps(payload).encode(), "application/json",
                       headers)
         owner._note_request(time.perf_counter() - t0)
@@ -150,11 +158,31 @@ class ServeServer(obs_server.StatusServer):
 
     def endpoint_names(self) -> list[str]:
         return super().endpoint_names() + ["/v1/score", "/v1/rank",
-                                           "/v1/topk"]
+                                           "/v1/topk", "/v1/refresh"]
 
     def status(self) -> dict:
         out = super().status()
         out["serve"] = self.service.stats_record()
+        return out
+
+    def health(self) -> dict:
+        """The obs chassis verdict + the serve-side watchdog: a score
+        dispatch in flight past ``serve.dispatch_stall_s`` is a WEDGED
+        dispatcher — requests queue behind a worker that will never answer
+        them — and the verdict goes critical (503), which is exactly what
+        the fleet router/supervisor key replica respawn off."""
+        out = super().health()
+        budget = self.service.cfg.serve.dispatch_stall_s
+        age = self.service.batcher.dispatch_age_s()
+        out["serve_watchdog"] = {
+            "dispatch_age_s": None if age is None else round(age, 3),
+            "dispatch_stall_budget_s": budget,
+        }
+        if budget is not None and age is not None and age > budget:
+            out["status"] = "critical"
+            out.setdefault("reasons", []).append(
+                f"serve dispatcher stalled: dispatch in flight "
+                f"{age:.1f}s > serve.dispatch_stall_s={budget:g}")
         return out
 
 
@@ -183,6 +211,20 @@ class ServeService:
         self._inflight_lock = threading.Lock()
         self._stats_seq = 0
         self._started_ts = time.time()
+        # Refresh-vs-drain exclusion: a refresh holds this for its whole
+        # restore+install; drain acquires it FIRST, so a SIGTERM landing
+        # mid-refresh waits for the atomic install (or its loud rejection)
+        # to finish before exit 75 — a tenant is never left half-registered.
+        self._refresh_lock = threading.Lock()
+        #: tenant -> checkpoint step its scoring variables came from (None =
+        #: the boot-time config recipe). /status + model_refresh evidence.
+        self.model_steps: dict[str, int | None] = {}
+        # Fleet identity (DDT_SERVE_REPLICA, set by serve/fleet.py): rides
+        # every stats record so a shared metrics stream attributes lines.
+        rep = os.environ.get("DDT_SERVE_REPLICA")
+        self.replica = int(rep) if rep is not None else None
+        self._watch_stop = threading.Event()
+        self._watch_thread: threading.Thread | None = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -202,6 +244,10 @@ class ServeService:
         return ok
 
     def stop(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+            self._watch_thread = None
         self.batcher.stop()
         self.server.stop()
         if self._installed and obs_server.current() is self.server:
@@ -226,19 +272,30 @@ class ServeService:
         write their responses. Returns whether everything drained in
         budget."""
         self._draining = True
-        self.batcher.stop_admission()
-        if self.logger is not None:
-            self.logger.log("serve_admission", tenant="*", action="drain",
-                            queue_depth=sum(
-                                self.batcher.stats()["queued"].values()))
-        drained = self.batcher.drain(self.cfg.serve.drain_timeout_s)
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline:
-            with self._inflight_lock:
-                if self._http_inflight == 0:
-                    break
-            time.sleep(0.01)
-        return drained
+        # A refresh in flight finishes (its install is one atomic swap) or
+        # rejects loudly BEFORE the drain proceeds; a refresh arriving
+        # after this sees _draining inside the lock and is refused. Without
+        # this handshake a SIGTERM mid-refresh raced the swap out of exit
+        # 75 with the tenant half-registered.
+        got_refresh = self._refresh_lock.acquire(
+            timeout=self.cfg.serve.drain_timeout_s)
+        try:
+            self.batcher.stop_admission()
+            if self.logger is not None:
+                self.logger.log("serve_admission", tenant="*", action="drain",
+                                queue_depth=sum(
+                                    self.batcher.stats()["queued"].values()))
+            drained = self.batcher.drain(self.cfg.serve.drain_timeout_s)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with self._inflight_lock:
+                    if self._http_inflight == 0:
+                        break
+                time.sleep(0.01)
+            return drained and got_refresh
+        finally:
+            if got_refresh:
+                self._refresh_lock.release()
 
     def wait_until_preempted(self) -> None:
         """The serve loop: heartbeat + stats/SLO cadence until SIGTERM/
@@ -314,6 +371,95 @@ class ServeService:
                      "indices": [int(i) for i in ranked],
                      "scores": [float(s) for s in scores]}, {}
 
+    def refresh_source(self) -> str | None:
+        return self.cfg.serve.refresh_from or self.cfg.train.checkpoint_dir
+
+    def handle_refresh(self, body: dict) -> tuple[int, dict, dict]:
+        tenant = body.get("tenant") or self.default_tenant
+        return self.refresh(tenant, directory=body.get("dir"),
+                            step=body.get("step"))
+
+    def refresh(self, tenant: str, *, directory: str | None = None,
+                step: int | None = None) -> tuple[int, dict, dict]:
+        """Zero-downtime model refresh: re-register ``tenant``'s scoring
+        variables from a training checkpoint, digest-verified before
+        install, swapped atomically between dispatches (``refresh_tenant``
+        holds the engine's dispatch lock for one assignment). Serving never
+        pauses: the restore runs outside every lock, and any request is
+        answered entirely by the old or entirely by the new model. Returns
+        the HTTP triple; every outcome is a ``model_refresh`` record."""
+        directory = directory or self.refresh_source()
+        if not directory:
+            return 400, {"error": "no refresh source: set serve.refresh_from "
+                                  "or train.checkpoint_dir (or pass "
+                                  "\"dir\")"}, {}
+        t0 = time.perf_counter()
+        with self._refresh_lock:
+            if self._draining:
+                return 503, {"error": "service is draining; refresh "
+                                      "refused"}, {}
+            try:
+                variables, used = self.engine.load_checkpoint_variables(
+                    directory, step)
+                self.engine.refresh_tenant(tenant, [variables])
+            except KeyError as exc:
+                # Unknown tenant: the caller's mistake, not the checkpoint's.
+                return 400, {"error": str(exc)[:300]}, {}
+            except Exception as exc:   # noqa: BLE001 — corrupt/missing ckpt
+                # CheckpointCorrupt, FileNotFoundError, a torn Orbax payload:
+                # rejected LOUDLY, old model untouched and still serving.
+                if self.logger is not None:
+                    self.logger.log("model_refresh", tenant=tenant,
+                                    status="rejected", dir=directory,
+                                    step=step, replica=self.replica,
+                                    error=repr(exc)[:300])
+                return 409, {"error": f"refresh rejected: {exc!r}"[:400],
+                             "tenant": tenant, "dir": directory,
+                             "status": "rejected"}, {}
+            self.model_steps[tenant] = used
+            wall_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            if self.logger is not None:
+                self.logger.log("model_refresh", tenant=tenant,
+                                status="installed", dir=directory, step=used,
+                                replica=self.replica, wall_ms=wall_ms)
+            return 200, {"tenant": tenant, "step": used,
+                         "status": "installed", "wall_ms": wall_ms}, {}
+
+    # ----------------------------------------------------- refresh watcher
+
+    def start_refresh_watch(self) -> None:
+        """The ``serve.refresh_poll_s`` watcher: poll the refresh source for
+        a durable step newer than the installed one and refresh the default
+        tenant when one lands. Manual ``POST /v1/refresh`` stays available
+        either way."""
+        poll = self.cfg.serve.refresh_poll_s
+        if poll is None or self._watch_thread is not None:
+            return
+        self._watch_thread = threading.Thread(
+            target=self._refresh_watch_loop, args=(float(poll),),
+            name="serve-refresh-watch", daemon=True)
+        self._watch_thread.start()
+
+    def _refresh_watch_loop(self, poll_s: float) -> None:
+        from .fleet import discover_steps
+        while not self._watch_stop.wait(poll_s):
+            if self._draining:
+                return
+            directory = self.refresh_source()
+            if not directory:
+                continue
+            try:
+                steps = discover_steps(directory)
+            except OSError:
+                continue
+            if not steps:
+                continue
+            newest = steps[-1]
+            installed = self.model_steps.get(self.default_tenant)
+            if installed is not None and newest <= installed:
+                continue
+            self.refresh(self.default_tenant, step=newest)
+
     def topk_prepare(self, tenant: str | None, method: str | None, k: int):
         """Resolve + force the resident scores (errors surface BEFORE the
         response status line), returning the streamable item iterator."""
@@ -342,6 +488,8 @@ class ServeService:
             "admitting": b["admitting"],
             "p50_ms": p50, "p95_ms": p95,
             "tenants": sorted(self.engine.tenants),
+            "model_steps": dict(self.model_steps),
+            "replica": self.replica,
             "programs": self.engine.program_stats(),
             "uptime_s": round(time.time() - self._started_ts, 3),
         }
@@ -394,6 +542,7 @@ def run_serve(cfg: Config, logger) -> dict | None:
                            method=m,
                            warm_s=round(time.perf_counter() - t0, 3))
         service.emit_stats()
+        service.start_refresh_watch()
         service.wait_until_preempted()   # raises Preempted on SIGTERM
         return {"serve": service.stats_record()}
     finally:

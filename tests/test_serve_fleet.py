@@ -1,0 +1,888 @@
+"""Serving-fleet acceptance (serve/fleet.py + serve/router.py, ISSUE 15).
+
+Three tiers, all tier-1:
+
+* **router unit seams** — jax-free, against fake stdlib HTTP replicas:
+  circuit breaker lifecycle (closed → open → half-open → close), the
+  retry-only-idempotent rule (keyless POST through a dead replica gets an
+  honest 502, keyed POSTs and GETs fail over), the idempotency replay
+  cache (a retried key never double-dispatches), hedging (the slow
+  primary's answer is cancelled, the hedge wins), the 503 + Retry-After
+  no-replica path, and the one-replica-at-a-time refresh roll that aborts
+  on the first rejection;
+* **in-process service seams** — a real engine over the tiny CPU dataset:
+  the wedged-dispatcher watchdog flips /healthz critical past
+  ``serve.dispatch_stall_s``; a refresh mid-hammer is ATOMIC (every
+  response bit-matches exactly one of {old, new} — never torn); a corrupt
+  refresh checkpoint is rejected digest-loudly with the old model still
+  serving; a drain racing an in-flight refresh waits for the atomic
+  install instead of exiting mid-swap (the PR's ServeService fix, pinned);
+* **the 2-replica kill drill** — a real ``cli serve`` fleet subprocess:
+  SIGKILL one replica mid-load (``kill_replica_after_requests``) with ZERO
+  client-visible failures (the router replays, the supervisor respawns on
+  the same port), served scores bit-identical to the offline
+  ``score_dataset`` truth before and after the churn, a corrupt refresh
+  rejected with the fleet still on the old model, a good refresh rolled
+  with capacity never zero, SIGTERM → exit 75, and the stream readable by
+  validate_metrics / run_monitor / the postmortem timeline.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.obs import slo as obs_slo
+from data_diet_distributed_tpu.obs import timeline as tl
+from data_diet_distributed_tpu.resilience.inject import truncate_checkpoint
+from data_diet_distributed_tpu.serve.fleet import discover_steps
+from data_diet_distributed_tpu.serve.router import (CircuitBreaker, Replica,
+                                                    ServeRouter)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stream_recs(path):
+    recs = []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue   # partial trailing line from a killed run
+    return recs
+
+
+# ======================================================================
+# Fake replicas: a stdlib HTTP server the router can route to, with
+# controllable latency, refresh verdicts, and a dispatch counter.
+# ======================================================================
+
+class _FakeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # noqa: A002
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            pass   # hedging closed our socket: the loser's write tears
+
+    def do_POST(self):   # noqa: N802
+        fake = self.server.fake
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n) if n else b""
+        if self.path == "/v1/refresh":
+            with fake.lock:
+                fake.refresh_hits.append(time.monotonic())
+                code = (fake.refresh_codes.pop(0)
+                        if fake.refresh_codes else 200)
+            if code == 200:
+                self._reply(200, {"status": "installed", "step": 10,
+                                  "tenant": "tiny"})
+            else:
+                self._reply(code, {"status": "rejected",
+                                   "error": "fake corrupt"})
+            return
+        with fake.lock:
+            fake.dispatches += 1
+        if fake.delay_s:
+            time.sleep(fake.delay_s)
+        self._reply(200, {"scores": [float(fake.index)],
+                          "served_by": fake.index})
+
+    do_GET = do_POST   # noqa: N815 — same behaviour for GET seams
+
+
+class _Fake:
+    def __init__(self, index):
+        self.index = index
+        self.delay_s = 0.0
+        self.dispatches = 0
+        self.refresh_hits = []
+        self.refresh_codes = []
+        self.lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakeHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.fake = self
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fakes():
+    pair = [_Fake(0), _Fake(1)]
+    yield pair
+    for f in pair:
+        try:
+            f.kill()
+        except OSError:
+            pass
+
+
+def _mk_router(fakes, **kw):
+    reps = [Replica(f.index, "127.0.0.1", f.port,
+                    breaker_failures=kw.pop("breaker_failures", 3),
+                    breaker_reset_s=kw.pop("breaker_reset_s", 0.3))
+            for f in fakes]
+    router = ServeRouter(reps, timeout_s=kw.pop("timeout_s", 10.0), **kw)
+    router.bind()
+    return router
+
+
+def _req(router, path="/v1/score", method="POST", key=None, timeout=15):
+    headers = {"Content-Type": "application/json"}
+    if key:
+        headers["Idempotency-Key"] = key
+    data = json.dumps({"indices": [0]}).encode() if method == "POST" else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}{path}", data=data, headers=headers,
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        try:
+            body = json.load(err)
+        except ValueError:
+            body = {}
+        return err.code, body, dict(err.headers)
+
+
+# ---------------------------------------------------------------- breaker
+
+def test_breaker_lifecycle_closed_open_half_open_close():
+    b = CircuitBreaker(failures=3, reset_s=0.2)
+    assert b.state == "closed" and b.allowing()
+    assert b.failure() is False
+    assert b.failure() is False
+    assert b.allowing()                      # 2 < threshold: still closed
+    assert b.failure() is True               # 3rd consecutive: OPENS
+    assert b.state == "open" and not b.allowing()
+    time.sleep(0.25)
+    assert b.allowing()                      # reset elapsed: half-open probe
+    assert b.acquire() is True
+    assert b.acquire() is False              # one probe slot only
+    assert b.success() is True               # probe success CLOSES (logged)
+    assert b.state == "closed" and b.allowing()
+    # A half-open probe FAILURE re-opens immediately.
+    for _ in range(3):
+        b.failure()
+    time.sleep(0.25)
+    assert b.acquire() is True
+    assert b.failure() is True
+    assert b.state == "open" and not b.allowing()
+    # A success while closed never claims a transition.
+    b2 = CircuitBreaker(failures=3, reset_s=0.2)
+    assert b2.success() is False
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(failures=3, reset_s=1.0)
+    b.failure(), b.failure()
+    b.success()
+    assert b.failure() is False and b.failure() is False   # count restarted
+    assert b.state == "closed"
+
+
+# ---------------------------------------------------------------- routing
+
+def test_keyed_post_fails_over_and_echoes_key(fakes):
+    fakes[0].kill()   # round-robin hits the corpse first
+    router = _mk_router(fakes, retries=2)
+    try:
+        code, body, headers = _req(router, key="k-failover")
+        assert code == 200 and body["served_by"] == 1
+        assert headers.get("Idempotency-Key") == "k-failover"
+        assert headers.get("X-Served-By") == "1"
+        assert router.counters["retries"] >= 1
+        assert router.counters["transport_failures"] >= 1
+    finally:
+        router.stop()
+
+
+def test_keyless_post_gets_honest_502_not_a_retry(fakes):
+    fakes[0].kill()
+    router = _mk_router(fakes, retries=2)
+    try:
+        code, body, _ = _req(router)    # no Idempotency-Key
+        assert code == 502, body
+        assert "not retried" in body["error"]
+        assert fakes[1].dispatches == 0   # the router never guessed
+    finally:
+        router.stop()
+
+
+def test_get_is_idempotent_and_fails_over(fakes):
+    fakes[0].kill()
+    router = _mk_router(fakes, retries=2)
+    try:
+        code, body, _ = _req(router, path="/v1/topk?k=3", method="GET")
+        assert code == 200 and body["served_by"] == 1
+    finally:
+        router.stop()
+
+
+def test_breaker_opens_then_routes_around_dead_replica(fakes):
+    fakes[0].kill()
+    router = _mk_router(fakes, retries=2, breaker_failures=2,
+                        breaker_reset_s=30.0)
+    try:
+        for i in range(4):
+            code, _, _ = _req(router, key=f"k-{i}")
+            assert code == 200
+        assert router.replicas[0].breaker.state == "open"
+        # Circuit open: requests stop probing the corpse entirely.
+        before = router.counters["transport_failures"]
+        for i in range(3):
+            _req(router, key=f"k2-{i}")
+        assert router.counters["transport_failures"] == before
+    finally:
+        router.stop()
+
+
+def test_replay_cache_never_double_dispatches(fakes):
+    router = _mk_router(fakes, retries=2)
+    try:
+        code1, body1, h1 = _req(router, key="k-replay")
+        n_after_first = fakes[0].dispatches + fakes[1].dispatches
+        code2, body2, h2 = _req(router, key="k-replay")
+        assert code1 == code2 == 200
+        assert body1 == body2
+        assert h2.get("X-Idempotent-Replay") == "1"
+        assert fakes[0].dispatches + fakes[1].dispatches == n_after_first
+        # A fresh key dispatches for real.
+        _req(router, key="k-fresh")
+        assert fakes[0].dispatches + fakes[1].dispatches == n_after_first + 1
+        assert router.counters["replays"] >= 1
+    finally:
+        router.stop()
+
+
+def test_hedge_duplicates_slow_request_and_cancels_loser(fakes):
+    fakes[0].delay_s = 3.0                 # wedged-but-listening primary
+    router = _mk_router(fakes, retries=2, hedge_ms=100)
+    try:
+        t0 = time.monotonic()
+        code, body, _ = _req(router, key="k-hedge")
+        wall = time.monotonic() - t0
+        assert code == 200 and body["served_by"] == 1
+        assert wall < 2.5                  # did not wait out the primary
+        assert router.counters["hedges"] >= 1
+        assert router.counters["hedge_wins"] >= 1
+    finally:
+        router.stop()
+
+
+def test_no_routable_replica_is_503_with_retry_after(fakes):
+    router = _mk_router(fakes, retry_after_s=2.5)
+    try:
+        router.set_health(0, False)
+        router.set_health(1, False)
+        code, body, headers = _req(router, key="k-none")
+        assert code == 503 and "no routable replica" in body["error"]
+        assert headers.get("Retry-After") == "2.5"
+        assert router.counters["no_replica"] == 1
+        assert router.available() == 0
+        assert router.health()["status"] == "critical"
+    finally:
+        router.stop()
+
+
+def test_stop_admission_refuses_with_503(fakes):
+    router = _mk_router(fakes)
+    try:
+        router.stop_admission()
+        code, body, _ = _req(router, key="k-drain")
+        assert code == 503 and "draining" in body["error"]
+        assert router.health()["status"] == "critical"
+    finally:
+        router.stop()
+
+
+def test_refresh_roll_is_sequential_and_aborts_on_rejection(fakes):
+    router = _mk_router(fakes)
+    try:
+        code, body, _ = _req(router, path="/v1/refresh")
+        assert code == 200 and body["status"] == "rolled"
+        assert [r["code"] for r in body["replicas"]] == [200, 200]
+        # One at a time: replica 1's install started after replica 0's.
+        assert fakes[0].refresh_hits[0] <= fakes[1].refresh_hits[0]
+        # A rejection at replica 0 aborts the roll: replica 1 untouched.
+        fakes[0].refresh_codes = [409]
+        n1 = len(fakes[1].refresh_hits)
+        code, body, _ = _req(router, path="/v1/refresh")
+        assert code == 409 and body["status"] == "roll_aborted"
+        assert len(fakes[1].refresh_hits) == n1
+        # An unroutable replica aborts too (rolling past it would tear the
+        # fleet when it heals).
+        router.set_health(1, False)
+        code, body, _ = _req(router, path="/v1/refresh")
+        assert code == 409
+        assert body["replicas"][-1]["status"] == "unreachable"
+    finally:
+        router.stop()
+
+
+def test_fleet_slo_units():
+    eng = obs_slo.SloEngine(fleet_p95_ms=10.0, fleet_available_frac=0.5)
+    eng.check_fleet(point=1, p95_ms=50.0, available_frac=0.0)
+    assert eng.total_violations == 2
+    eng.check_fleet(point=1, p95_ms=50.0, available_frac=0.0)
+    assert eng.total_violations == 2   # one record per (objective, point)
+    eng.check_fleet(point=2, p95_ms=5.0, available_frac=1.0)
+    assert eng.total_violations == 2   # back in contract
+    assert {v["slo"] for v in eng.violations} == {"fleet_p95",
+                                                  "fleet_availability"}
+
+
+def test_discover_steps_orbax_and_tiered(tmp_path):
+    d = tmp_path / "ck"
+    (d / "3").mkdir(parents=True)
+    (d / "12").mkdir()
+    (d / "not-a-step").mkdir()
+    tiered = tmp_path / "ck_tiered" / "step_20"
+    tiered.mkdir(parents=True)
+    (tiered / "promoted.rank0.json").write_text(json.dumps({"world": 2}))
+    assert discover_steps(str(d)) == [3, 12]   # rank1 marker missing
+    (tiered / "promoted.rank1.json").write_text(json.dumps({"world": 2}))
+    assert discover_steps(str(d)) == [3, 12, 20]
+    assert discover_steps(str(tmp_path / "nope")) == []
+
+
+# ======================================================================
+# In-process service seams: wedge watchdog, refresh atomicity, corrupt
+# refresh, drain-vs-refresh. One shared engine/service (class-scoped —
+# the engine boot + compile is the expensive part).
+# ======================================================================
+
+def _cfg(tmp_path, *extra):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "model.arch=tiny_cnn",
+        "train.half_precision=false",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        "score.pretrain_epochs=0", "score.batch_size=64",
+        "score.method=el2n",
+        "serve.port=0", "serve.coalesce_ms=2", "serve.tenant=tiny",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        f"obs.heartbeat_dir={tmp_path}/hb", *extra])
+
+
+def _save_state(cfg, tmp_path, name, seed, step):
+    """A real durable checkpoint (the refresh source) from a fresh init."""
+    import jax
+
+    from data_diet_distributed_tpu.checkpoint import CheckpointManager
+    from data_diet_distributed_tpu.train.state import create_train_state
+    state = create_train_state(cfg, jax.random.key(seed), steps_per_epoch=4)
+    directory = str(tmp_path / name)
+    mngr = CheckpointManager(directory)
+    mngr.save(step, state)
+    mngr.close()
+    return directory, {"params": state.params,
+                       "batch_stats": state.batch_stats}
+
+
+class TestServiceSeams:
+    IDS = [3, 7, 10, 200, 5]
+
+    @pytest.fixture(scope="class")
+    def svc(self, tmp_path_factory, tiny_ds):
+        import jax
+
+        from data_diet_distributed_tpu.ops.scoring import score_dataset
+        from data_diet_distributed_tpu.serve.engine import ServeEngine
+        from data_diet_distributed_tpu.serve.server import ServeService
+        tmp_path = tmp_path_factory.mktemp("fleet_seams")
+        cfg = _cfg(tmp_path, "serve.dispatch_stall_s=1.0",
+                   "serve.request_timeout_s=120")
+        logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+        train_ds, _ = tiny_ds
+        engine = ServeEngine(cfg, logger=logger)
+        var_a = jax.jit(engine.model.init, static_argnames=("train",))(
+            jax.random.key(0),
+            np.zeros((1, *train_ds.images.shape[1:]), np.float32),
+            train=False)
+        engine.register_tenant("tiny", train_ds, variables_seeds=[var_a])
+        refresh_dir, var_b = _save_state(cfg, tmp_path, "refresh_ck",
+                                         seed=5, step=10)
+        corrupt_dir, _ = _save_state(cfg, tmp_path, "corrupt_ck",
+                                     seed=9, step=20)
+        truncate_checkpoint(corrupt_dir, 20)
+        truth = {
+            "a": score_dataset(engine.model, [var_a], train_ds,
+                               method="el2n", batch_size=64,
+                               sharder=engine.sharder),
+            "b": score_dataset(engine.model, [var_b], train_ds,
+                               method="el2n", batch_size=64,
+                               sharder=engine.sharder),
+        }
+        assert not np.array_equal(truth["a"], truth["b"])
+        service = ServeService(engine, cfg, logger=logger)
+        assert service.start()
+        sc = _load_tool("serve_client")
+        client = sc.ServeClient(f"http://127.0.0.1:{service.port}",
+                                timeout_s=300.0)
+        client.score(indices=self.IDS)   # compile the serving program once
+        yield dict(cfg=cfg, tmp_path=tmp_path, engine=engine,
+                   service=service, client=client, truth=truth,
+                   var_a=var_a, var_b=var_b, refresh_dir=refresh_dir,
+                   corrupt_dir=corrupt_dir, logger=logger)
+        service.stop()
+        logger.close()
+
+    def _score(self, svc):
+        return np.asarray(svc["client"].score(indices=self.IDS)["scores"],
+                          np.float32)
+
+    def _matches(self, svc, got, which):
+        return np.array_equal(got, svc["truth"][which][self.IDS])
+
+    def test_wedged_dispatcher_flips_healthz_critical(self, svc):
+        """A dispatch in flight past serve.dispatch_stall_s is a wedged
+        dispatcher: /healthz goes critical (what the fleet keys respawn
+        off), and recovers once the dispatch completes."""
+        engine, client = svc["engine"], svc["client"]
+        done = {}
+        engine._lock.acquire()   # wedge: the dispatch blocks inside score
+        try:
+            t = threading.Thread(
+                target=lambda: done.update(r=client.score(indices=[1, 2])),
+                daemon=True)
+            t.start()
+            deadline = time.monotonic() + 15
+            verdict = None
+            while time.monotonic() < deadline:
+                verdict = client.healthz()
+                if verdict["status"] == "critical":
+                    break
+                time.sleep(0.1)
+            assert verdict is not None and verdict["status"] == "critical", \
+                verdict
+            assert any("stalled" in r for r in verdict["reasons"]), verdict
+            assert verdict["serve_watchdog"]["dispatch_age_s"] > 1.0
+        finally:
+            engine._lock.release()
+        t.join(timeout=60)
+        assert len(done["r"]["scores"]) == 2   # the wedged request completed
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.healthz()["status"] == "ok":
+                break
+            time.sleep(0.1)
+        assert client.healthz()["status"] == "ok"
+
+    def test_refresh_swap_is_atomic_under_hammer(self, svc):
+        """ISSUE acceptance: any request served during a refresh is
+        bit-identical to the old model or the new one — never torn."""
+        responses = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                responses.append(self._score(svc))
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for k in range(6):
+                if k % 2 == 0:
+                    code, payload, _ = svc["service"].refresh(
+                        "tiny", directory=svc["refresh_dir"], step=10)
+                    assert code == 200 and payload["status"] == "installed"
+                    assert payload["step"] == 10
+                    expect = "b"
+                else:
+                    svc["engine"].refresh_tenant("tiny", [svc["var_a"]])
+                    expect = "a"
+                # The swap is immediately and completely visible.
+                assert self._matches(svc, self._score(svc), expect)
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert len(responses) >= 6
+        for got in responses:
+            assert self._matches(svc, got, "a") \
+                or self._matches(svc, got, "b"), got   # never torn
+        assert svc["service"].model_steps["tiny"] == 10
+        # Leave the tenant on the INIT model for the corruption test below.
+        svc["engine"].refresh_tenant("tiny", [svc["var_a"]])
+
+    def test_corrupt_refresh_rejected_digest_loudly(self, svc):
+        """A truncated refresh checkpoint fails restore_checked BEFORE any
+        install: 409 + a model_refresh status=rejected record, and the old
+        model keeps serving bit-identically."""
+        before = self._score(svc)
+        code, payload, _ = svc["service"].refresh(
+            "tiny", directory=svc["corrupt_dir"], step=20)
+        assert code == 409, payload
+        assert payload["status"] == "rejected"
+        assert np.array_equal(self._score(svc), before)   # old model serving
+        recs = _stream_recs(svc["cfg"].obs.metrics_path)
+        rejected = [r for r in recs if r.get("kind") == "model_refresh"
+                    and r.get("status") == "rejected"]
+        assert rejected and rejected[-1]["tenant"] == "tiny"
+        installed = [r for r in recs if r.get("kind") == "model_refresh"
+                     and r.get("status") == "installed"]
+        assert installed   # the hammer test's successful installs
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_lines([json.dumps(r) for r in recs],
+                                     where="stream")
+        assert problems == [], problems
+
+    def test_unknown_tenant_refresh_is_400_not_rejected(self, svc):
+        code, payload, _ = svc["service"].refresh(
+            "nope", directory=svc["refresh_dir"], step=10)
+        assert code == 400 and "unknown tenant" in payload["error"]
+
+    def test_drain_waits_for_inflight_refresh(self, svc):
+        """The satellite fix, pinned: SIGTERM (drain) landing mid-refresh
+        waits for the atomic install instead of racing the swap out of
+        exit 75 — and a refresh arriving after the drain is refused."""
+        from data_diet_distributed_tpu.serve.server import ServeService
+        engine = svc["engine"]
+        service2 = ServeService(engine, svc["cfg"], logger=svc["logger"])
+        assert service2.start()
+        real_load = engine.load_checkpoint_variables
+        result = {}
+
+        def slow_load(directory, step=None):
+            time.sleep(0.8)
+            return svc["var_b"], 77
+
+        engine.load_checkpoint_variables = slow_load
+        try:
+            t = threading.Thread(
+                target=lambda: result.update(
+                    r=service2.refresh("tiny", directory="ignored")),
+                daemon=True)
+            t.start()
+            time.sleep(0.2)          # the refresh is mid-restore
+            t0 = time.monotonic()
+            drained = service2.drain()
+            wall = time.monotonic() - t0
+            t.join(timeout=30)
+        finally:
+            engine.load_checkpoint_variables = real_load
+            service2.stop()
+            # The slow_load installed var_b: put the init model back.
+            engine.refresh_tenant("tiny", [svc["var_a"]])
+        assert drained is True
+        assert wall >= 0.4           # it WAITED for the install
+        code, payload, _ = result["r"]
+        assert code == 200 and payload["step"] == 77   # finished, not torn
+        assert service2.model_steps["tiny"] == 77
+        code, payload, _ = service2.refresh("tiny",
+                                            directory=svc["refresh_dir"])
+        assert code == 503 and "drain" in payload["error"]
+
+
+# ======================================================================
+# The 2-replica fleet kill + refresh drill (real `cli serve` subprocess).
+# ======================================================================
+
+class TestFleetDrill:
+    IDS = [3, 7, 10, 200, 5]
+
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory, tiny_ds):
+        import jax
+
+        from data_diet_distributed_tpu.ops.scoring import score_dataset
+        from data_diet_distributed_tpu.serve.engine import ServeEngine
+        tmp_path = tmp_path_factory.mktemp("fleet_drill")
+        train_ds, _ = tiny_ds
+        cfg = _cfg(tmp_path)
+        # The offline truth, via the SAME deterministic recipes the replicas
+        # use: score.pretrain_epochs=0 + seeds=(0,) → init-at-seed variables
+        # (bit-identical across processes on the same 8-device geometry),
+        # and the refresh checkpoint's saved state.
+        engine = ServeEngine(cfg, logger=None)
+        init_vars = engine.scoring_variables(train_ds)
+        refresh_dir, ck_vars = _save_state(cfg, tmp_path, "refresh_ck",
+                                           seed=5, step=10)
+        truth_init = score_dataset(engine.model, init_vars, train_ds,
+                                   method="el2n", batch_size=64,
+                                   sharder=engine.sharder)
+        truth_new = score_dataset(engine.model, [ck_vars], train_ds,
+                                  method="el2n", batch_size=64,
+                                  sharder=engine.sharder)
+        assert not np.array_equal(truth_init, truth_new)
+        # Corrupt a HIGHER step in the same refresh dir: a stepless refresh
+        # takes the newest durable step — the torn one.
+        from data_diet_distributed_tpu.checkpoint import CheckpointManager
+        from data_diet_distributed_tpu.train.state import create_train_state
+        state20 = create_train_state(cfg, jax.random.key(9),
+                                     steps_per_epoch=4)
+        mngr = CheckpointManager(refresh_dir)
+        mngr.save(20, state20)
+        mngr.close()
+        truncate_checkpoint(refresh_dir, 20)
+
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+            PYTHONPATH=str(REPO),
+            # Replica 1 SIGKILLs itself with its 7th dispatch in flight.
+            DDT_FAULT_PLAN=json.dumps(
+                {"rank": 1, "kill_replica_after_requests": 6}))
+        metrics = tmp_path / "metrics.jsonl"
+        out = dict(tmp_path=tmp_path, metrics=metrics,
+                   truth_init=truth_init, truth_new=truth_new)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+             "data.dataset=synthetic", "data.synthetic_size=256",
+             "data.batch_size=64", "model.arch=tiny_cnn",
+             "train.half_precision=false", "score.pretrain_epochs=0",
+             "score.batch_size=64", "score.method=el2n",
+             "serve.replicas=2", "serve.router_port=0", "serve.port=0",
+             "serve.tenant=tiny", "serve.coalesce_ms=2", "serve.warm=false",
+             "serve.health_poll_s=0.25", "serve.breaker_reset_s=0.5",
+             "serve.stats_every_s=2", "serve.request_timeout_s=120",
+             "elastic.max_restarts=4", "elastic.backoff_s=0.2",
+             f"serve.refresh_from={refresh_dir}",
+             f"obs.metrics_path={metrics}",
+             f"obs.heartbeat_dir={tmp_path}/hb",
+             f"train.checkpoint_dir={tmp_path}/ckpt"],
+            env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        sc = _load_tool("serve_client")
+        try:
+            # 1. The router address comes from the fleet's launch record.
+            port = None
+            deadline = time.monotonic() + 120
+            while port is None and time.monotonic() < deadline:
+                assert proc.poll() is None, proc.stdout.read()[-4000:]
+                time.sleep(0.25)
+                if metrics.exists():
+                    for rec in _stream_recs(metrics):
+                        if rec.get("kind") == "serve_fleet" \
+                                and rec.get("event") == "launch":
+                            port = rec["router_port"]
+            assert port, "fleet never published its router port"
+            url = f"http://127.0.0.1:{port}"
+            client = sc.ServeClient(url, timeout_s=300.0, retries=6,
+                                    backoff_s=0.25)
+            probe = sc.ServeClient(url, timeout_s=10.0)   # no-retry healthz
+
+            def wait_available(n, budget_s):
+                deadline = time.monotonic() + budget_s
+                verdict = None
+                while time.monotonic() < deadline:
+                    assert proc.poll() is None, proc.stdout.read()[-4000:]
+                    try:
+                        verdict = probe.healthz()
+                    except sc.ServeError:
+                        verdict = None
+                    if verdict and verdict.get("available") == n:
+                        return verdict
+                    time.sleep(0.25)
+                raise AssertionError(
+                    f"fleet never reached {n} available: {verdict}")
+
+            wait_available(2, 240)
+            out["pre_kill"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            # 2. Open-loop load. Replica 1 SIGKILLs itself mid-dispatch
+            #    (~its 7th); the router replays onto replica 0 and the
+            #    supervisor respawns — ZERO client-visible failures.
+            out["load"] = sc.load_generate(
+                url, rps=12, duration_s=8, batch=8, max_index=255,
+                timeout_s=120, retries=6, backoff_s=0.25)
+            wait_available(2, 240)    # the respawned replica is back
+            out["post_kill"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            # 3. Corrupt refresh: the newest durable step (20) is torn —
+            #    rejected digest-loudly, fleet still on the old model.
+            try:
+                out["corrupt_refresh"] = client.refresh()
+            except sc.ServeError as err:
+                out["corrupt_refresh"] = err
+            out["post_corrupt"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            # 4. The good refresh (step 10), rolled one replica at a time
+            #    under a hammer: every response must bit-match exactly one
+            #    of {old, new}, and capacity must never reach zero.
+            hammered, avail_seen = [], []
+            stop = threading.Event()
+
+            def hammer():
+                hc = sc.ServeClient(url, timeout_s=300.0, retries=6)
+                while not stop.is_set():
+                    hammered.append(np.asarray(
+                        hc.score(indices=self.IDS)["scores"], np.float32))
+
+            def watch_capacity():
+                while not stop.is_set():
+                    try:
+                        avail_seen.append(probe.healthz().get("available"))
+                    except sc.ServeError:
+                        pass
+                    time.sleep(0.05)
+
+            hthreads = [threading.Thread(target=hammer, daemon=True),
+                        threading.Thread(target=watch_capacity, daemon=True)]
+            for t in hthreads:
+                t.start()
+            try:
+                out["roll"] = client.refresh(step=10)
+            finally:
+                time.sleep(0.3)
+                stop.set()
+                for t in hthreads:
+                    t.join(timeout=120)
+            out["hammered"], out["avail_seen"] = hammered, avail_seen
+            out["post_roll"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            # 5. SIGTERM: admission stops, replicas drain, exit 75.
+            proc.send_signal(signal.SIGTERM)
+            out["rc"] = proc.wait(timeout=120)
+            out["stdout"] = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out["records"] = _stream_recs(metrics)
+        return out
+
+    def test_zero_client_visible_failures_through_replica_kill(self, drill):
+        load = drill["load"]
+        assert load["errors"] == 0, (load, drill["stdout"][-4000:])
+        assert load["rejected"] == 0, load
+        assert load["ok"] == load["sent"] and load["ok"] > 50, load
+
+    def test_replica_death_and_respawn_observed(self, drill):
+        revs = [r for r in drill["records"]
+                if r.get("kind") == "replica_event"]
+        deaths = [r for r in revs if r["event"] == "died"]
+        assert deaths and deaths[0]["replica"] == 1
+        assert deaths[0]["signal"] == signal.SIGKILL
+        respawns = [r for r in revs if r["event"] == "respawn"]
+        assert respawns and respawns[0]["replica"] == 1
+        assert respawns[0]["generation"] == 1
+        # Respawned IN PLACE: the router table's port never changed.
+        spawn_port = next(r["port"] for r in revs
+                          if r["event"] == "spawn" and r["replica"] == 1)
+        assert respawns[0]["port"] == spawn_port
+
+    def test_served_scores_bit_identical_to_offline_truth(self, drill):
+        truth = drill["truth_init"][self.IDS]
+        np.testing.assert_array_equal(drill["pre_kill"], truth)
+        # The respawned replica serves the SAME bits (deterministic init).
+        np.testing.assert_array_equal(drill["post_kill"], truth)
+        np.testing.assert_array_equal(drill["post_corrupt"], truth)
+
+    def test_corrupt_refresh_rejected_old_model_serving(self, drill):
+        err = drill["corrupt_refresh"]
+        # ServeError by shape, not class identity (_load_tool builds a fresh
+        # serve_client module per call).
+        assert isinstance(err, Exception) and hasattr(err, "status"), err
+        assert err.status in (409, 502), err
+        assert err.payload.get("status") == "roll_aborted", err.payload
+        rejected = [r for r in drill["records"]
+                    if r.get("kind") == "model_refresh"
+                    and r.get("status") == "rejected"]
+        assert rejected, "no replica logged the digest rejection"
+
+    def test_refresh_rolls_with_capacity_never_zero(self, drill):
+        roll = drill["roll"]
+        assert roll["status"] == "rolled", roll
+        assert [r["code"] for r in roll["replicas"]] == [200, 200]
+        np.testing.assert_array_equal(drill["post_roll"],
+                                      drill["truth_new"][self.IDS])
+        assert drill["avail_seen"] and min(drill["avail_seen"]) >= 1
+        old = drill["truth_init"][self.IDS]
+        new = drill["truth_new"][self.IDS]
+        for got in drill["hammered"]:   # atomic: old or new, never torn
+            assert np.array_equal(got, old) or np.array_equal(got, new), got
+        installs = [r for r in drill["records"]
+                    if r.get("kind") == "model_refresh"
+                    and r.get("status") == "installed"
+                    and r.get("step") == 10]
+        assert len(installs) == 2   # one per replica
+        assert any(r.get("status") == "roll_complete"
+                   for r in drill["records"]
+                   if r.get("kind") == "model_refresh")
+
+    def test_fleet_sigterm_exits_75_with_valid_terminal_stream(self, drill):
+        assert drill["rc"] == 75, drill["stdout"][-4000:]
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_file(str(drill["metrics"]),
+                                    expect_terminal=True)
+        assert problems == [], problems
+        summary = drill["records"][-1]
+        assert summary["kind"] == "run_summary"
+        assert summary["exit_class"] == "preempted"
+        lin = summary["lineage"]
+        assert lin["replicas"] == 2 and lin["respawns"] == 1
+        assert lin["generations"] == [0, 1]
+        fleet_events = {r["event"] for r in drill["records"]
+                        if r.get("kind") == "serve_fleet"}
+        assert {"supervise", "launch", "stats",
+                "drain", "preempted_exit"} <= fleet_events
+
+    def test_run_monitor_once_exits_zero(self, drill):
+        monitor = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "run_monitor.py"),
+             "--metrics", str(drill["metrics"]), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert monitor.returncode == 0, monitor.stdout + monitor.stderr
+        view = json.loads(monitor.stdout.strip().splitlines()[-1])
+        sf = view["serve_fleet"]
+        assert sf["deaths"] >= 1 and sf["respawns"] >= 1
+        assert sf["refreshes"] >= 2 and sf["refresh_rejected"] >= 1
+
+    def test_postmortem_timeline_names_death_and_respawn(self, drill):
+        events = tl.build_timeline({"records": drill["records"]})
+        deaths = [e for e in events if e["kind"] == "replica_event"
+                  and e.get("event") == "died"]
+        respawns = [e for e in events if e["kind"] == "replica_event"
+                    and e.get("event") == "respawn"]
+        assert deaths and deaths[0].get("replica") == 1
+        assert respawns and respawns[0].get("replica") == 1
+        assert deaths[0]["ts"] <= respawns[0]["ts"]
+        # All lineage stays at attempt 0: replica churn is steady-state,
+        # never an unexplained run-level recovery chain.
+        view = tl.lineage_view(drill["records"])
+        assert view["attempts"] == 1 and view["unexplained"] == []

@@ -123,7 +123,9 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
     if metrics:
         recs = tail_records(metrics, ("epoch", "run_summary", "slo_violation",
                                       "fleet_status", "summary",
-                                      "elastic_event", "soak_report"))
+                                      "elastic_event", "soak_report",
+                                      "serve_fleet", "replica_event",
+                                      "model_refresh"))
         view = None
         if lineage:
             from data_diet_distributed_tpu.obs.timeline import (lineage_view,
@@ -169,6 +171,32 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                 "restarts": sum(r.get("event") == "restart" for r in elastic),
                 "last": elastic[-1].get("event"),
                 "world": elastic[-1].get("world"),
+            }
+        serve_fleet = [r for r in recs if r.get("kind") == "serve_fleet"]
+        replica_events = [r for r in recs if r.get("kind") == "replica_event"]
+        if serve_fleet or replica_events:
+            # Display-only, like the elastic block: replica churn the
+            # fleet absorbed never flips the verdict — only SLO violations
+            # and staleness do.
+            stats = [r for r in serve_fleet if r.get("event") == "stats"]
+            refresh = [r for r in recs if r.get("kind") == "model_refresh"]
+            out["serve_fleet"] = {
+                "events": len(serve_fleet) + len(replica_events),
+                "respawns": sum(r.get("event") == "respawn"
+                                for r in replica_events),
+                "deaths": sum(r.get("event") in ("died", "exited")
+                              for r in replica_events),
+                "wedged": sum(r.get("event") == "wedged"
+                              for r in replica_events),
+                "refreshes": sum(r.get("status") == "installed"
+                                 for r in refresh),
+                "refresh_rejected": sum(r.get("status") == "rejected"
+                                        for r in refresh),
+                "last": (serve_fleet[-1].get("event")
+                         if serve_fleet else None),
+                "available": (stats[-1].get("available")
+                              if stats else None),
+                "p95_ms": stats[-1].get("p95_ms") if stats else None,
             }
         soak = [r for r in recs if r.get("kind") == "soak_report"]
         if soak:
@@ -295,6 +323,14 @@ def render(info: dict) -> str:
                      f"{el['shrinks']} shrink / {el['grows']} grow / "
                      f"{el['restarts']} restart; last={el['last']} "
                      f"world={el['world']}")
+    sf = info.get("serve_fleet")
+    if sf:
+        lines.append(f"serve fleet: {sf['events']} event(s) — "
+                     f"{sf['deaths']} death(s) / {sf['wedged']} wedged / "
+                     f"{sf['respawns']} respawn(s); refreshes "
+                     f"{sf['refreshes']} (+{sf['refresh_rejected']} "
+                     f"rejected) available={sf['available']} "
+                     f"p95={_fmt(sf['p95_ms'])}ms")
     lin = info.get("lineage")
     if lin:
         lines.append(f"lineage: {lin['attempts']} attempt(s), worlds "

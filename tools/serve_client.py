@@ -39,6 +39,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 
 
 class ServeError(Exception):
@@ -53,29 +54,64 @@ class ServeError(Exception):
 
 
 class ServeClient:
-    def __init__(self, base_url: str, timeout_s: float = 60.0):
+    """``retries > 0`` makes the client honest about a replicated service:
+    429 waits out the server's own Retry-After hint; a refused/torn
+    connection (a replica or router mid-respawn) backs off exponentially
+    (``backoff_s`` doubling, capped at 5 s). Every POST carries an
+    ``Idempotency-Key`` — minted once per logical call and REUSED across
+    its retries, so the router's replay cache guarantees a retried
+    ``/v1/score`` is never dispatched twice."""
+
+    def __init__(self, base_url: str, timeout_s: float = 60.0,
+                 retries: int = 0, backoff_s: float = 0.25):
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.retry_count = 0     # total retries performed (load report)
 
     # ------------------------------------------------------------ plumbing
 
-    def _request(self, path: str, payload: dict | None = None):
+    def _request(self, path: str, payload: dict | None = None,
+                 idempotency_key: str | None = None):
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            f"{self.base}{path}", data=data,
-            headers={"Content-Type": "application/json"} if data else {})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                return json.load(resp)
-        except urllib.error.HTTPError as err:
+        headers = {"Content-Type": "application/json"} if data else {}
+        if data is not None:
+            headers["Idempotency-Key"] = idempotency_key or uuid.uuid4().hex
+        attempt = 0
+        while True:
+            req = urllib.request.Request(f"{self.base}{path}", data=data,
+                                         headers=dict(headers))
             try:
-                body = json.load(err)
-            except Exception:   # noqa: BLE001 — a torn error body is still an error
-                body = {"error": str(err)}
-            retry_after = err.headers.get("Retry-After")
-            raise ServeError(err.code, body,
-                             float(retry_after) if retry_after else None
-                             ) from None
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    return json.load(resp)
+            except urllib.error.HTTPError as err:
+                try:
+                    body = json.load(err)
+                except Exception:   # noqa: BLE001 — a torn error body is still an error
+                    body = {"error": str(err)}
+                retry_after = err.headers.get("Retry-After")
+                retry_after_s = float(retry_after) if retry_after else None
+                if err.code in (429, 503) and attempt < self.retries:
+                    # Backpressure with a hint: honor the server's own
+                    # Retry-After over our backoff schedule.
+                    attempt += 1
+                    self.retry_count += 1
+                    time.sleep(retry_after_s if retry_after_s is not None
+                               else self._backoff(attempt))
+                    continue
+                raise ServeError(err.code, body, retry_after_s) from None
+            except (urllib.error.URLError, OSError) as err:
+                if attempt < self.retries:
+                    attempt += 1
+                    self.retry_count += 1
+                    time.sleep(self._backoff(attempt))
+                    continue
+                raise ServeError(0, {"error": f"transport: {err}"}) from None
+
+    def _backoff(self, attempt: int) -> float:
+        return min(5.0, self.backoff_s * (2 ** (attempt - 1)))
 
     # ------------------------------------------------------------ endpoints
 
@@ -105,26 +141,52 @@ class ServeClient:
     def topk(self, k: int = 10, *, tenant: str | None = None,
              method: str | None = None):
         """Streamed top-k: yields ``(index, score)`` as lines arrive —
-        the full response never buffers client-side either."""
+        the full response never buffers client-side either. A transport
+        failure BEFORE the first line retries like any idempotent GET;
+        mid-stream failures surface (the caller has partial state)."""
         qs = f"k={int(k)}"
         if tenant:
             qs += f"&tenant={tenant}"
         if method:
             qs += f"&method={method}"
-        req = urllib.request.Request(f"{self.base}/v1/topk?{qs}")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+        attempt = 0
+        while True:
+            req = urllib.request.Request(f"{self.base}/v1/topk?{qs}")
+            try:
+                resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+            except urllib.error.HTTPError as err:
+                try:
+                    body = json.load(err)
+                except Exception:   # noqa: BLE001
+                    body = {"error": str(err)}
+                raise ServeError(err.code, body) from None
+            except (urllib.error.URLError, OSError) as err:
+                if attempt < self.retries:
+                    attempt += 1
+                    self.retry_count += 1
+                    time.sleep(self._backoff(attempt))
+                    continue
+                raise ServeError(0, {"error": f"transport: {err}"}) from None
+            with resp:
                 for line in resp:
                     line = line.strip()
                     if line:
                         rec = json.loads(line)
                         yield rec["index"], rec["score"]
-        except urllib.error.HTTPError as err:
-            try:
-                body = json.load(err)
-            except Exception:   # noqa: BLE001
-                body = {"error": str(err)}
-            raise ServeError(err.code, body) from None
+            return
+
+    def refresh(self, *, tenant: str | None = None, directory: str | None = None,
+                step: int | None = None) -> dict:
+        """``POST /v1/refresh`` — against a replica it installs there;
+        against the router it rolls the fleet one replica at a time."""
+        payload: dict = {}
+        if tenant:
+            payload["tenant"] = tenant
+        if directory:
+            payload["dir"] = directory
+        if step is not None:
+            payload["step"] = int(step)
+        return self._request("/v1/refresh", payload)
 
     def healthz(self) -> dict:
         try:
@@ -151,11 +213,15 @@ def percentile(values: list[float], q: float) -> float | None:
 def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
                   max_index: int = 255, tenant: str | None = None,
                   method: str | None = None, timeout_s: float = 60.0,
-                  seed: int = 0) -> dict:
+                  seed: int = 0, retries: int = 0,
+                  backoff_s: float = 0.25) -> dict:
     """Drive ``/v1/score`` open-loop at ``rps`` for ``duration_s``; returns
     the latency/outcome report dict ``main`` prints (and ``bench.py --task
-    serve`` embeds)."""
-    client = ServeClient(url, timeout_s=timeout_s)
+    serve`` embeds). ``retries`` makes each request survive backpressure
+    and replica churn (the fleet drills drive with retries > 0 and assert
+    errors == 0)."""
+    client = ServeClient(url, timeout_s=timeout_s, retries=retries,
+                         backoff_s=backoff_s)
     rng = random.Random(seed)
     lock = threading.Lock()
     lat_ms: list[float] = []
@@ -196,6 +262,7 @@ def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
     return {
         "sent": n_sent, "ok": outcomes["ok"],
         "rejected": outcomes["rejected"], "errors": outcomes["errors"],
+        "retried": client.retry_count,
         "offered_rps": round(rps, 2),
         "achieved_rps": round(outcomes["ok"] / wall, 2) if wall else None,
         "batch": batch, "wall_s": round(wall, 3),
@@ -221,19 +288,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--tenant", default=None)
     parser.add_argument("--method", default=None)
     parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--retries", type=int, default=0,
+                        help="per-request retry budget (429 honors "
+                             "Retry-After; refused connections back off "
+                             "exponentially)")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as one JSON object")
     args = parser.parse_args(argv)
     report = load_generate(args.url, rps=args.rps, duration_s=args.duration,
                            batch=args.batch, max_index=args.max_index,
                            tenant=args.tenant, method=args.method,
-                           timeout_s=args.timeout)
+                           timeout_s=args.timeout, retries=args.retries)
     if args.json:
         print(json.dumps(report))
     else:
         print(f"sent {report['sent']}  ok {report['ok']}  "
               f"rejected(429) {report['rejected']}  "
-              f"errors {report['errors']}")
+              f"errors {report['errors']}  retried {report['retried']}")
         print(f"latency ms: p50 {report['p50_ms']}  p95 {report['p95_ms']}  "
               f"max {report['max_ms']}")
         print(f"rate: offered {report['offered_rps']}/s  "

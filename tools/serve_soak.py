@@ -1,0 +1,417 @@
+"""Serve-fleet soak driver: the replicated scoring service's proof harness.
+
+The training plane's faults are proven by ``tools/imagenet_soak.py``; this
+is the serving-side twin (ROADMAP "Scoring as a service", phase 2). Each
+cycle boots the REAL production fleet (``cli serve`` with
+``serve.replicas=N``: N serve children behind the health-aware router,
+supervised by ``serve/fleet.ServeFleet``), injects exactly one fault, and
+drives open-loop load through the router with ``tools/serve_client.py``'s
+generator. The acceptance bar is the ISSUE's: **zero client-visible request
+failures** through every fault, judged per cycle by
+
+* the load report (``errors == 0 and rejected == 0``),
+* ``tools/run_monitor.py --once`` exit codes over the cycle's records
+  (0 healthy / 1 SLO-violated / 2 unreachable-or-stale),
+* ``tools/validate_metrics.py`` schema validation of the stream, and
+* fault-specific record forensics (a kill cycle must leave a
+  ``replica_event`` died/respawn pair; a wedge cycle a
+  wedged/wedged_reaped/respawn chain; a refresh cycle a digest-loud
+  ``model_refresh`` rejection AND a completed one-replica-at-a-time roll
+  with capacity never zero; a sigterm cycle exit 75 with
+  ``exit_class=preempted``).
+
+Fault cycles (``--schedule``):
+
+* ``kill``    — replica 1 SIGKILLs itself mid-dispatch
+  (``kill_replica_after_requests``); the router replays the dead
+  replica's in-flight idempotent requests and the fleet respawns it.
+* ``wedge``   — replica 1's dispatcher hangs (``wedge_dispatcher_after``);
+  its /healthz goes critical past ``serve.dispatch_stall_s``, the router
+  routes around it, the fleet drains + relaunches.
+* ``refresh`` — a TORN newest checkpoint step is refresh-rejected
+  (digest verification, old model keeps serving), then a good step is
+  rolled across replicas one at a time under hammer load.
+* ``sigterm`` — the whole fleet is preempted after a clean load pass:
+  admission stops, replicas drain, exit 75.
+* ``none``    — control cycle: load + clean shutdown, no fault.
+
+The driver emits one ``{"kind": "soak_report"}`` record (and prints it as
+the final JSON line); exit 0 iff every cycle passed.
+
+CPU recipe (numbers recorded in SCALING.md §3b)::
+
+  env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/serve_soak.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: fault name -> DDT_FAULT_PLAN payload for the fleet's children. Replica 1
+#: is targeted (rank == fleet index via DDT_SERVE_REPLICA) so replica 0
+#: survives to carry the load while the fault plays out.
+FAULTS = {
+    "none": None,
+    "kill": {"rank": 1, "kill_replica_after_requests": 4},
+    "wedge": {"rank": 1, "wedge_dispatcher_after": 3, "hang_seconds": 600.0},
+    "refresh": None,
+    "sigterm": None,
+}
+
+SCHEDULE = "kill,wedge,refresh,sigterm"
+
+
+def _stream_recs(path: str) -> list[dict]:
+    recs = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue   # a torn tail line is the reader's problem
+    except OSError:
+        pass
+    return recs
+
+
+def _make_refresh_ckpt(cfg, directory: str) -> None:
+    """A GOOD step 10 plus a TORN (truncated-payload) step 20 in one
+    checkpoint dir: a stepless refresh takes the newest durable step — the
+    torn one — so digest verification must reject it; step 10 then rolls."""
+    import jax
+
+    from data_diet_distributed_tpu.checkpoint import CheckpointManager
+    from data_diet_distributed_tpu.resilience.inject import truncate_checkpoint
+    from data_diet_distributed_tpu.train.state import create_train_state
+    mngr = CheckpointManager(directory)
+    mngr.save(10, create_train_state(cfg, jax.random.key(5),
+                                     steps_per_epoch=4))
+    mngr.save(20, create_train_state(cfg, jax.random.key(9),
+                                     steps_per_epoch=4))
+    mngr.close()
+    truncate_checkpoint(directory, 20)
+
+
+def _cycle_overrides(args, cycle_dir: str, refresh_dir: str) -> list[str]:
+    return [
+        "data.dataset=synthetic", f"data.synthetic_size={args.size}",
+        "data.batch_size=64", f"model.arch={args.arch}",
+        "train.half_precision=false", "score.pretrain_epochs=0",
+        "score.batch_size=64", f"score.method={args.method}",
+        f"serve.replicas={args.replicas}", "serve.router_port=0",
+        "serve.port=0", "serve.tenant=soak", "serve.coalesce_ms=2",
+        "serve.warm=false", "serve.health_poll_s=0.25",
+        "serve.breaker_reset_s=0.5", "serve.stats_every_s=2",
+        "serve.dispatch_stall_s=1.0", "serve.request_timeout_s=120",
+        # A wedged dispatcher can never finish its in-flight work, so a
+        # tight drain bound turns the wedge recovery wall from
+        # O(drain_timeout) into O(detection + respawn). The clean SIGTERM
+        # drain is unaffected: it returns as soon as in-flight completes.
+        "serve.drain_timeout_s=5.0", "elastic.reap_timeout_s=20",
+        f"elastic.max_restarts={args.max_restarts}", "elastic.backoff_s=0.2",
+        f"serve.refresh_from={refresh_dir}",
+        f"obs.metrics_path={os.path.join(cycle_dir, 'metrics.jsonl')}",
+        f"obs.heartbeat_dir={os.path.join(cycle_dir, 'hb')}",
+        f"train.checkpoint_dir={os.path.join(cycle_dir, 'ckpt')}",
+    ]
+
+
+def _monitor_once(metrics: str) -> tuple[int, dict]:
+    monitor = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "run_monitor.py")
+    proc = subprocess.run(
+        [sys.executable, monitor, "--metrics", metrics, "--once", "--json"],
+        capture_output=True, text=True, timeout=60)
+    try:
+        view = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        view = {"error": f"unparseable monitor output: {proc.stdout[-200:]}"}
+    return proc.returncode, view
+
+
+def _forensics(fault: str, recs: list[dict], rc: int,
+               refresh_verdicts: dict) -> list[str]:
+    """Fault-specific record checks; each miss is one problem string."""
+    problems = []
+    rep = [r for r in recs if r.get("kind") == "replica_event"]
+    refresh = [r for r in recs if r.get("kind") == "model_refresh"]
+    events = {r.get("event") for r in rep}
+    if fault == "kill":
+        if not any(r.get("event") == "died" and r.get("signal")
+                   for r in rep):
+            problems.append("kill: no replica_event died-by-signal record")
+        if "respawn" not in events:
+            problems.append("kill: no replica_event respawn record")
+    elif fault == "wedge":
+        for want in ("wedged", "wedged_reaped", "respawn"):
+            if want not in events:
+                problems.append(f"wedge: no replica_event {want} record")
+    elif fault == "refresh":
+        if not any(r.get("status") == "rejected" for r in refresh):
+            problems.append("refresh: torn step never digest-rejected")
+        if not any(r.get("status") == "roll_complete" for r in refresh):
+            problems.append("refresh: no roll_complete record")
+        installed = [r for r in refresh if r.get("status") == "installed"]
+        if len(installed) < refresh_verdicts.get("replicas", 2):
+            problems.append(f"refresh: only {len(installed)} installs "
+                            "— roll did not reach every replica")
+        if not refresh_verdicts.get("corrupt_rejected"):
+            problems.append("refresh: client saw the torn refresh succeed")
+        if refresh_verdicts.get("roll", {}).get("status") != "rolled":
+            problems.append(f"refresh: good roll did not complete: "
+                            f"{refresh_verdicts.get('roll')}")
+        if refresh_verdicts.get("min_available", 0) < 1:
+            problems.append("refresh: capacity hit zero during the roll")
+    if fault == "sigterm" or rc is not None:
+        # Every cycle ends in SIGTERM; the preemption contract always holds.
+        if rc != 75:
+            problems.append(f"fleet exit {rc}, want 75 (preempted)")
+        summaries = [r for r in recs if r.get("kind") == "run_summary"]
+        if not summaries or summaries[-1].get("exit_class") != "preempted":
+            problems.append("terminal run_summary is not exit_class=preempted")
+    return problems
+
+
+def run_cycle(args, index: int, fault: str, refresh_dir: str,
+              workdir: str) -> dict:
+    import serve_client as sc
+    from validate_metrics import validate_file
+
+    cycle_dir = os.path.join(workdir, f"cycle{index}_{fault}")
+    os.makedirs(cycle_dir, exist_ok=True)
+    metrics = os.path.join(cycle_dir, "metrics.jsonl")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("DDT_FAULT_PLAN", "DDT_SERVE_REPLICA")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    plan = FAULTS[fault]
+    if plan is not None:
+        env["DDT_FAULT_PLAN"] = json.dumps(plan)
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+         *_cycle_overrides(args, cycle_dir, refresh_dir)],
+        env=env, cwd=cycle_dir, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    verdict = {"cycle": index, "fault": fault}
+    refresh_verdicts = {"replicas": args.replicas}
+    rc = None
+    try:
+        port = None
+        deadline = time.monotonic() + args.boot_timeout
+        while port is None and time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("fleet died during boot: "
+                                   + proc.stdout.read()[-2000:])
+            time.sleep(0.25)
+            for rec in _stream_recs(metrics):
+                if rec.get("kind") == "serve_fleet" \
+                        and rec.get("event") == "launch":
+                    port = rec["router_port"]
+        if port is None:
+            raise RuntimeError("fleet never published its router port")
+        url = f"http://127.0.0.1:{port}"
+        probe = sc.ServeClient(url, timeout_s=10.0)
+        client = sc.ServeClient(url, timeout_s=300.0, retries=6,
+                                backoff_s=0.25)
+
+        def wait_available(n, budget_s):
+            stop_at = time.monotonic() + budget_s
+            seen = None
+            while time.monotonic() < stop_at:
+                if proc.poll() is not None:
+                    raise RuntimeError("fleet died mid-cycle: "
+                                       + proc.stdout.read()[-2000:])
+                try:
+                    seen = probe.healthz()
+                except sc.ServeError:
+                    seen = None
+                if seen and seen.get("available") == n:
+                    return
+                time.sleep(0.25)
+            raise RuntimeError(f"never reached {n} available: {seen}")
+
+        wait_available(args.replicas, args.boot_timeout)
+        # Open-loop load through the router — the fault (if any) fires
+        # under it, and the bar is zero client-visible failures.
+        verdict["load"] = sc.load_generate(
+            url, rps=args.rps, duration_s=args.duration, batch=8,
+            max_index=args.size - 1, timeout_s=120, retries=6,
+            backoff_s=0.25)
+        if fault in ("kill", "wedge"):
+            wait_available(args.replicas, args.respawn_timeout)
+        elif fault == "refresh":
+            # Torn step 20 is the newest — a stepless refresh must be
+            # rejected digest-loudly while the old model keeps serving.
+            try:
+                client.refresh()
+                refresh_verdicts["corrupt_rejected"] = False
+            except sc.ServeError as err:
+                refresh_verdicts["corrupt_rejected"] = err.status in (409,
+                                                                      502)
+            # The good step, rolled one replica at a time under hammer
+            # load; capacity (router-available replicas) must never be 0.
+            stop = threading.Event()
+            avail_seen: list[int] = []
+
+            def watch():
+                while not stop.is_set():
+                    try:
+                        avail_seen.append(probe.healthz().get("available"))
+                    except sc.ServeError:
+                        pass
+                    time.sleep(0.05)
+
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
+            hammer = threading.Thread(
+                target=lambda: verdict.__setitem__(
+                    "roll_load", sc.load_generate(
+                        url, rps=args.rps, duration_s=3.0, batch=8,
+                        max_index=args.size - 1, timeout_s=120,
+                        retries=6, backoff_s=0.25)),
+                daemon=True)
+            hammer.start()
+            try:
+                refresh_verdicts["roll"] = client.refresh(step=10)
+            finally:
+                hammer.join(timeout=120)
+                stop.set()
+                watcher.join(timeout=10)
+            refresh_verdicts["min_available"] = min(
+                [a for a in avail_seen if a is not None], default=0)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    except Exception as err:   # the cycle verdict carries the failure
+        verdict["error"] = f"{type(err).__name__}: {err}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if rc is None:
+            rc = proc.returncode
+    recs = _stream_recs(metrics)
+    monitor_exit, view = _monitor_once(metrics)
+    summary = view.get("run_summary") or {}
+    try:
+        stream_problems = validate_file(metrics)
+    except OSError as err:
+        stream_problems = [f"{metrics}: unreadable ({err})"]
+    problems = list(verdict.get("error") and [verdict["error"]] or [])
+    loads = [verdict.get("load") or {}, verdict.get("roll_load") or {}]
+    sent = sum(ld.get("sent", 0) for ld in loads)
+    errors = sum(ld.get("errors", 0) for ld in loads)
+    rejected = sum(ld.get("rejected", 0) for ld in loads)
+    if sent == 0:
+        problems.append("no load reached the router")
+    if errors or rejected:
+        problems.append(f"client-visible failures: {errors} errors, "
+                        f"{rejected} rejected of {sent}")
+    if monitor_exit != 0:
+        problems.append(f"run_monitor --once exit {monitor_exit}")
+    problems += [f"stream: {p}" for p in stream_problems[:5]]
+    problems += _forensics(fault, recs, rc, refresh_verdicts)
+    verdict.update(
+        rc=rc, wall_s=round(time.perf_counter() - t0, 1),
+        requests=sent, errors=errors, rejected=rejected,
+        monitor_exit=monitor_exit, exit_class=summary.get("exit_class"),
+        slo=summary.get("slo"), refresh=refresh_verdicts,
+        p95_ms=(verdict.get("load") or {}).get("p95_ms"),
+        problems=problems, ok=not problems)
+    # Load reports are bulky; the verdict keys above carry what the
+    # soak_report needs.
+    verdict.pop("load", None)
+    verdict.pop("roll_load", None)
+    return verdict
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="bounded CPU lane: pins JAX_PLATFORMS=cpu and "
+                             "an 8-device host geometry for the fleet "
+                             "children (the SCALING.md §3b recipe)")
+    parser.add_argument("--workdir", default="/tmp/ddt_serve_soak")
+    parser.add_argument("--schedule", default=None,
+                        help=f"comma-separated fault cycles from "
+                             f"{sorted(FAULTS)} (default: {SCHEDULE})")
+    parser.add_argument("--cycles", type=int, default=None,
+                        help="total cycles (schedule repeats); default: one "
+                             "pass over the schedule")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--rps", type=float, default=12.0)
+    parser.add_argument("--duration", type=float, default=6.0,
+                        help="per-cycle load seconds")
+    parser.add_argument("--size", type=int, default=256)
+    parser.add_argument("--arch", default="tiny_cnn")
+    parser.add_argument("--method", default="el2n")
+    parser.add_argument("--max-restarts", type=int, default=4)
+    parser.add_argument("--boot-timeout", type=float, default=240.0)
+    parser.add_argument("--respawn-timeout", type=float, default=240.0)
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args()
+
+    if args.smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    schedule = [f.strip() for f in (args.schedule or SCHEDULE).split(",")
+                if f.strip()]
+    unknown = [f for f in schedule if f not in FAULTS]
+    if unknown:
+        raise SystemExit(f"unknown fault(s) {unknown}; known: "
+                         f"{sorted(FAULTS)}")
+    if args.cycles:
+        schedule = (schedule * args.cycles)[: args.cycles]
+
+    from data_diet_distributed_tpu.config import load_config
+    from data_diet_distributed_tpu.resilience.elastic import JsonlLogger
+    os.makedirs(args.workdir, exist_ok=True)
+    refresh_dir = os.path.join(args.workdir, "refresh_ck")
+    # One shared refresh checkpoint dir (good step 10 + torn step 20),
+    # built with the SAME model geometry the cycles serve.
+    cfg = load_config(None, _cycle_overrides(args, args.workdir,
+                                             refresh_dir))
+    _make_refresh_ckpt(cfg, refresh_dir)
+
+    driver_log = JsonlLogger(os.path.join(args.workdir, "soak.jsonl"),
+                             echo=not args.quiet)
+    t0 = time.perf_counter()
+    cycles = []
+    for i, fault in enumerate(schedule):
+        verdict = run_cycle(args, i, fault, refresh_dir, args.workdir)
+        cycles.append(verdict)
+        driver_log.log("elastic_event", event="soak_cycle", **verdict)
+    ok = bool(cycles) and all(c["ok"] for c in cycles)
+    report = {
+        "cycles": len(cycles), "ok": ok,
+        "faults": [c["fault"] for c in cycles],
+        "passed": sum(c["ok"] for c in cycles),
+        "monitor_exits": [c["monitor_exit"] for c in cycles],
+        "cycle_wall_s": [c["wall_s"] for c in cycles],
+        "p95_ms": [c["p95_ms"] for c in cycles],
+        "replicas": args.replicas, "smoke": bool(args.smoke),
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "per_cycle": cycles,
+    }
+    driver_log.log("soak_report", **report)
+    driver_log.close()
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,6 +68,11 @@ KNOWN_KINDS = frozenset({
     # loop's aggregate stats/SLO cadence, and admission-control decisions
     # (429 rejections, drain transitions).
     "serve_request", "serve_stats", "serve_admission",
+    # Serving fleet (serve/fleet.py + serve/router.py): fleet lifecycle
+    # (supervise/launch/stats/drain/give_up/complete), per-replica
+    # deaths/wedges/respawns + router breaker transitions, and model
+    # refresh installs/rejections/rolls.
+    "serve_fleet", "replica_event", "model_refresh",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -125,6 +130,13 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "serve_request": ("tenant", "method", "n", "wall_ms"),
     "serve_stats": ("requests", "dispatches", "p95_ms"),
     "serve_admission": ("tenant", "action"),
+    # Serving fleet. Null-tolerant like elastic_event: only the event name
+    # (and the replica index, for replica_event) is universal — a breaker
+    # transition has no rc, a spawn has no signal. model_refresh's tenant
+    # may be null on a fleet-wide roll with no tenant named.
+    "serve_fleet": ("event",),
+    "replica_event": ("replica", "event"),
+    "model_refresh": ("tenant", "status"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
