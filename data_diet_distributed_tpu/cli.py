@@ -50,15 +50,19 @@ def main(argv: list[str] | None = None) -> int:
     run_started = time.time()
     command, cfg, args = _build(sys.argv[1:] if argv is None else argv)
     from .resilience import elastic as elastic_mod
-    if command == "serve" and cfg.serve.replicas > 1 \
+    if command == "serve" \
+            and (cfg.serve.replicas > 1
+                 or cfg.serve.max_replicas is not None) \
             and os.environ.get("DDT_SERVE_REPLICA") is None:
         # Serve-fleet supervisor mode: jax-free like the elastic
         # supervisor — spawns `serve.replicas` single-replica children of
         # this same invocation (DDT_SERVE_REPLICA set, serve.replicas=1
         # forced, so they take the serving path below), fronts them with
         # the health-aware router, and respawns casualties per the fleet
-        # policy. Checked BEFORE the elastic branch: a serve command with
-        # replicas is a fleet, whatever elastic.enabled says.
+        # policy. An autoscaled fleet (serve.max_replicas) is a fleet even
+        # at replicas=1 — it needs the supervisor to grow. Checked BEFORE
+        # the elastic branch: a serve command with replicas is a fleet,
+        # whatever elastic.enabled says.
         from .serve.fleet import ServeFleet
         logger = elastic_mod.JsonlLogger(cfg.obs.metrics_path)
         fleet = ServeFleet(cfg, config_path=args.config,

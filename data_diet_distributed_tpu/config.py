@@ -396,6 +396,55 @@ class ServeConfig:
     # Idempotency-Key header; a retried request replays the cached
     # response instead of double-dispatching).
     idempotency_cache: int = 256
+    # --- cross-host placement (RemoteReplicaBackend) --------------------
+    # Per-slot host list: replica i runs on hosts[i % len(hosts)], spawned
+    # through the remote_launch template and dialed at that host. () = all
+    # replicas local (the default backend). Every listed host goes through
+    # the template — list "127.0.0.1" to exercise the remote path locally.
+    hosts: tuple[str, ...] = ()
+    # Remote-launch command template, formatted with {host} — the same
+    # worker-launch plumbing the multihost tests use. The template's argv
+    # prefix executes a command on the host (e.g. "ssh -o BatchMode=yes
+    # {host}"); the child's env rides behind it via `env K=V ...`. The
+    # launcher process is supervised exactly like a local child: its
+    # lifetime is the remote replica's lifetime (ssh semantics). Required
+    # when hosts is non-empty.
+    remote_launch: str | None = None
+    # --- SLO-driven autoscaler (serve/fleet.py Autoscaler) --------------
+    # Fleet-size bounds: setting max_replicas turns the autoscaler on
+    # (min_replicas defaults to serve.replicas). Scaling signals are the
+    # same ones check_fleet/check_serve judge: router tick p95 vs
+    # obs.slo_fleet_p95_ms, summed replica queue depth vs
+    # obs.slo_serve_queue_depth, reject fraction vs
+    # obs.slo_serve_reject_frac. Both null = static fleet (PR-15).
+    min_replicas: int | None = None
+    max_replicas: int | None = None
+    # Hysteresis: consecutive violating stats ticks before a scale-up,
+    # consecutive headroom (idle / comfortably-under-floor) ticks before a
+    # scale-down, and the cooldown wall between any two actions.
+    scale_up_after: int = 2
+    scale_down_after: int = 5
+    scale_cooldown_s: float = 10.0
+    # --- partition probation (dead process vs dead network) -------------
+    # Consecutive unreachable health polls — process still alive, replica
+    # previously seen healthy — that classify as a network partition. A
+    # partitioned replica is quarantined (breaker + unroutable) and
+    # re-probed with backoff; it never spends restart budget.
+    partition_after_misses: int = 3
+    # Probation re-probe backoff: starts at probe_backoff_s, doubles per
+    # missed probe, capped at probe_backoff_max_s.
+    probe_backoff_s: float = 0.5
+    probe_backoff_max_s: float = 8.0
+    # --- canary-first refresh (serve/router.py roll) --------------------
+    # Routed requests the first-rolled (canary) replica must answer before
+    # the roll continues to the rest of the fleet; the roll aborts and the
+    # canary is rolled BACK to the prior model when its window error rate
+    # or p95 regresses past the fleet SLO floors (obs.slo_fleet_p95_ms /
+    # obs.slo_serve_reject_frac). None = no canary hold (the PR-15 roll).
+    canary_requests: int | None = None
+    # Canary-hold wall bound; zero routed traffic inside it is judged
+    # inconclusive and the roll proceeds (recorded as such).
+    canary_timeout_s: float = 30.0
 
 
 @dataclass
@@ -869,6 +918,50 @@ class Config:
         if sv.idempotency_cache < 1:
             raise ValueError(f"serve.idempotency_cache must be >= 1, got "
                              f"{sv.idempotency_cache}")
+        if sv.hosts and sv.remote_launch is None:
+            raise ValueError(
+                "serve.hosts names remote placements but serve.remote_launch "
+                "is null — every listed host is spawned through the launch "
+                "template")
+        if sv.remote_launch is not None and "{host}" not in sv.remote_launch:
+            raise ValueError(
+                f"serve.remote_launch must contain a {{host}} placeholder, "
+                f"got {sv.remote_launch!r}")
+        if sv.min_replicas is not None and sv.max_replicas is None:
+            raise ValueError(
+                "serve.min_replicas without serve.max_replicas — the "
+                "autoscaler is enabled by setting max_replicas")
+        if sv.max_replicas is not None:
+            min_eff = (sv.min_replicas if sv.min_replicas is not None
+                       else sv.replicas)
+            if not 1 <= min_eff <= sv.replicas <= sv.max_replicas:
+                raise ValueError(
+                    f"autoscaler bounds need 1 <= min_replicas "
+                    f"({min_eff}) <= replicas ({sv.replicas}) <= "
+                    f"max_replicas ({sv.max_replicas})")
+        if sv.scale_up_after < 1 or sv.scale_down_after < 1:
+            raise ValueError(
+                f"serve.scale_up_after/scale_down_after must be >= 1 "
+                f"(hysteresis windows in stats ticks), got "
+                f"{sv.scale_up_after}/{sv.scale_down_after}")
+        if sv.scale_cooldown_s < 0:
+            raise ValueError(f"serve.scale_cooldown_s must be >= 0, got "
+                             f"{sv.scale_cooldown_s}")
+        if sv.partition_after_misses < 1:
+            raise ValueError(f"serve.partition_after_misses must be >= 1, "
+                             f"got {sv.partition_after_misses}")
+        if not 0 < sv.probe_backoff_s <= sv.probe_backoff_max_s:
+            raise ValueError(
+                f"probation backoff needs 0 < probe_backoff_s <= "
+                f"probe_backoff_max_s, got {sv.probe_backoff_s}/"
+                f"{sv.probe_backoff_max_s}")
+        if sv.canary_requests is not None and sv.canary_requests < 1:
+            raise ValueError(
+                f"serve.canary_requests must be >= 1 (or null for no "
+                f"canary hold), got {sv.canary_requests}")
+        if sv.canary_timeout_s <= 0:
+            raise ValueError(f"serve.canary_timeout_s must be > 0, got "
+                             f"{sv.canary_timeout_s}")
         return self
 
 

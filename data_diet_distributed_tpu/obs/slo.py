@@ -508,3 +508,30 @@ def note_training_step(*, logger=None) -> None:
     the recovery clock is not armed (the common case)."""
     if _ENGINE is not None and _ENGINE._recovery_anchor is not None:
         _ENGINE.note_training_step(logger=logger)
+
+
+def judge_canary(*, served: int, errors: int, p95_ms: float | None,
+                 p95_floor_ms: float | None,
+                 error_frac_floor: float | None = None
+                 ) -> tuple[bool, list[str]]:
+    """The canary-roll verdict (``serve/router.py``), here because its
+    floors ARE the fleet SLOs: a canary fails when its window error rate
+    exceeds the tolerated fraction (default: any error at all) or its
+    window p95 regresses past the fleet p95 floor. Pure — the router
+    gathers the window, this names the regression. Returns
+    ``(ok, reasons)``; an empty window is the caller's problem (it judges
+    inconclusive before calling)."""
+    reasons: list[str] = []
+    if served > 0:
+        frac = errors / served
+        tol = error_frac_floor if error_frac_floor is not None else 0.0
+        if frac > tol:
+            reasons.append(
+                f"canary error rate {frac:.3f} > {tol:g} "
+                f"({errors}/{served} requests)")
+        if (p95_floor_ms is not None and p95_ms is not None
+                and p95_ms > p95_floor_ms):
+            reasons.append(
+                f"canary p95 {p95_ms:.1f}ms > fleet floor "
+                f"{p95_floor_ms:g}ms")
+    return not reasons, reasons

@@ -186,7 +186,7 @@ def build_timeline(artifacts: dict) -> list[dict]:
                                               "status", "step", "epoch",
                                               "world", "saved_world", "slo",
                                               "signal", "cause", "exit_class",
-                                              "replica")
+                                              "replica", "action")
                           if k in rec}})
     for dumped in artifacts.get("flightrec") or []:
         rank, attempt = dumped.get("rank"), dumped.get("attempt")
@@ -472,7 +472,7 @@ def merge_perfetto(traces: list[dict], out_path: str,
             ev = dict(ev, pid=pid)
             merged.append(ev)
     marker_kinds = {"fault", "preempted", "resume", "recovery",
-                    "elastic_event", "slo_violation"}
+                    "elastic_event", "slo_violation", "autoscale_event"}
     for rec in records or []:
         if rec.get("kind") not in marker_kinds:
             continue

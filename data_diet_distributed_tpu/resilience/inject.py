@@ -85,6 +85,21 @@ class FaultPlan:
     # keep queueing, /healthz goes critical past serve.dispatch_stall_s,
     # the fleet drains + respawns.
     wedge_dispatcher_after: int | None = None
+    # --- serve network fault classes (serve/server.py HTTP layer) ------
+    # Black-hole the replica's HTTP surface (/healthz included) once >= K
+    # requests have completed, for partition_seconds — the process stays
+    # ALIVE: the injected twin of a network partition, which the fleet
+    # must quarantine + probe (never respawn, never spend restart budget)
+    # and un-quarantine when it heals.
+    partition_replica_after: int | None = None
+    partition_seconds: float = 30.0
+    # Add this much latency to every HTTP response on the targeted replica
+    # — the slow-network / regressed-deploy twin (drives the autoscaler's
+    # p95 pressure). With slow_if_step set, the latency applies only while
+    # that checkpoint step is the installed model: the canary-rollback
+    # drill's "deliberately-regressed model", deterministic by step.
+    slow_replica_ms: float | None = None
+    slow_if_step: int | None = None
     rank: int | None = None                # target process_index (None = all)
 
 
@@ -92,6 +107,9 @@ class FaultInjector:
     def __init__(self):
         self.plan: FaultPlan | None = None
         self.fired: set[str] = set()
+        # Wall until which this replica's HTTP surface is black-holed
+        # (armed by partition_replica_after at the serve_dispatch site).
+        self.partition_until: float | None = None
 
     def _rank_targeted(self) -> bool:
         """True when this process is the plan's target (always, untargeted).
@@ -176,12 +194,42 @@ class FaultInjector:
                     and self._rank_targeted():
                 self.fired.add("wedge_dispatcher_after")
                 time.sleep(self.plan.hang_seconds)
+            k = self.plan.partition_replica_after
+            if k is not None and ctx["completed"] >= k \
+                    and "partition_replica_after" not in self.fired \
+                    and self._rank_targeted():
+                self.fired.add("partition_replica_after")
+                self.partition_until = (time.monotonic()
+                                        + self.plan.partition_seconds)
         elif site == "checkpoint_saved":
             if self._due("truncate_after_save_step", ctx["step"]):
                 # Barrier on the async save first: truncating a file that is
                 # still being written tests the writer, not the verifier.
                 ctx["manager"].all_steps()
                 truncate_checkpoint(ctx["directory"], ctx["step"])
+
+    def serve_partitioned(self) -> bool:
+        """True while the armed partition window is open. Expiry clears the
+        window — the heal is observable (the replica answers again), which
+        is what the reconnect half of the probation drill asserts."""
+        if self.partition_until is None:
+            return False
+        if time.monotonic() >= self.partition_until:
+            self.partition_until = None
+            return False
+        return True
+
+    def serve_slow_ms(self, model_step: int | None = None) -> float | None:
+        """Injected per-response latency for this replica, or None. Gated
+        to the installed model step when ``slow_if_step`` is armed."""
+        if self.plan is None or self.plan.slow_replica_ms is None:
+            return None
+        if not self._rank_targeted():
+            return None
+        if self.plan.slow_if_step is not None \
+                and model_step != self.plan.slow_if_step:
+            return None
+        return self.plan.slow_replica_ms
 
     def transform(self, site: str, value, **ctx):
         if self.plan is None:
@@ -203,11 +251,13 @@ _INJECTOR = FaultInjector()
 def activate(plan: FaultPlan) -> None:
     _INJECTOR.plan = plan
     _INJECTOR.fired = set()
+    _INJECTOR.partition_until = None
 
 
 def deactivate() -> None:
     _INJECTOR.plan = None
     _INJECTOR.fired = set()
+    _INJECTOR.partition_until = None
 
 
 def active_plan() -> FaultPlan | None:
@@ -220,6 +270,14 @@ def fire(site: str, **ctx) -> None:
 
 def transform(site: str, value, **ctx):
     return _INJECTOR.transform(site, value, **ctx)
+
+
+def serve_partitioned() -> bool:
+    return _INJECTOR.serve_partitioned()
+
+
+def serve_slow_ms(model_step: int | None = None) -> float | None:
+    return _INJECTOR.serve_slow_ms(model_step)
 
 
 def activate_from_env(env_var: str = "DDT_FAULT_PLAN") -> FaultPlan | None:

@@ -140,14 +140,24 @@ class Replica:
         self.health: dict = {}
         self.generation = 0
         self.breaker = CircuitBreaker(breaker_failures, breaker_reset_s)
+        # Scale-down tombstone: a retired slot keeps its index (set_health
+        # and events address replicas positionally) but never routes and
+        # never counts toward capacity. Slots are only ever appended.
+        self.retired = False
+        # Per-replica outcome window (router._stats_lock guards): the
+        # canary hold resets it after an install and judges it against the
+        # fleet SLO floors.
+        self.window_served = 0
+        self.window_errors = 0
+        self.window_lat_ms: deque = deque(maxlen=1024)
 
     def routable(self) -> bool:
-        return self.healthy and self.breaker.allowing()
+        return self.healthy and not self.retired and self.breaker.allowing()
 
     def view(self) -> dict:
         return {"replica": self.index, "port": self.port,
                 "healthy": self.healthy, "breaker": self.breaker.state,
-                "generation": self.generation,
+                "generation": self.generation, "retired": self.retired,
                 "status": self.health.get("status")}
 
 
@@ -224,7 +234,11 @@ class ServeRouter:
     def __init__(self, replicas: list[Replica], *, host: str = "127.0.0.1",
                  port: int = 0, retries: int = 2, hedge_ms: float | None = None,
                  timeout_s: float = 60.0, idem_cache: int = 256,
-                 retry_after_s: float = 1.0, logger=None, on_refresh=None):
+                 retry_after_s: float = 1.0, logger=None, on_refresh=None,
+                 canary_requests: int | None = None,
+                 canary_timeout_s: float = 30.0,
+                 canary_p95_floor_ms: float | None = None,
+                 canary_error_frac: float | None = None):
         self.replicas = list(replicas)
         self.host = host
         self.port = int(port)
@@ -248,6 +262,25 @@ class ServeRouter:
         self.counters = {"requests": 0, "proxied": 0, "retries": 0,
                          "replays": 0, "hedges": 0, "hedge_wins": 0,
                          "no_replica": 0, "transport_failures": 0}
+        # Canary-first refresh roll: hold after the first replica installs
+        # until it has answered canary_requests routed requests (bounded by
+        # canary_timeout_s), judged against the fleet SLO floors. None =
+        # the plain one-at-a-time roll.
+        self.canary_requests = canary_requests
+        self.canary_timeout_s = float(canary_timeout_s)
+        self.canary_p95_floor_ms = canary_p95_floor_ms
+        self.canary_error_frac = canary_error_frac
+        #: {"dir":..., "step":...} of the last fully-rolled model — what a
+        #: failed canary rolls BACK to.
+        self._last_installed: dict | None = None
+        # Per-stats-tick latency window (take_tick_stats drains it): the
+        # autoscaler's pressure signal — unlike the rolling 4096-sample
+        # deque, an idle tick reads empty instead of replaying stale spikes.
+        self._tick_lat: list[float] = []
+        #: Supervisor self-monitoring (serve/fleet.py): a dead supervisor
+        #: thread appends its epitaph here and /healthz goes critical — a
+        #: supervisor whose control loops died must stop LOOKING healthy.
+        self.supervisor_faults: list[str] = []
         self._httpd: _Server | None = None
         self._thread: threading.Thread | None = None
 
@@ -294,12 +327,44 @@ class ServeRouter:
         if verdict is not None:
             rep.health = verdict
 
+    def active_replicas(self) -> list[Replica]:
+        """Non-retired slots — capacity denominators and roll targets.
+        Snapshots the table, which only ever grows (append/retire)."""
+        return [r for r in list(self.replicas) if not r.retired]
+
+    def add_replica(self, host: str, port: int, *, breaker_failures: int = 3,
+                    breaker_reset_s: float = 2.0) -> Replica:
+        """Autoscale grow: append a new slot (index = table length),
+        unhealthy until the fleet's poller sees its first /healthz."""
+        rep = Replica(len(self.replicas), host, port,
+                      breaker_failures=breaker_failures,
+                      breaker_reset_s=breaker_reset_s)
+        rep.healthy = False
+        self.replicas.append(rep)
+        return rep
+
+    def retire(self, index: int) -> None:
+        """Autoscale shrink: tombstone the slot (it keeps its index)."""
+        rep = self.replicas[index]
+        rep.retired = True
+        rep.healthy = False
+
+    def clear_quarantine(self, index: int) -> None:
+        """Reconnect path (fleet probation): a successful supervisor probe
+        closes the breaker immediately instead of waiting out reset_s +
+        a live half-open probe."""
+        rep = self.replicas[index]
+        if rep.breaker.success():
+            self._event(rep.index, "breaker_close", port=rep.port,
+                        cause="reconnect")
+
     def _candidates(self, exclude: set[int]) -> list[Replica]:
         with self._rr_lock:
             start = self._rr
             self._rr += 1
-        n = len(self.replicas)
-        order = [self.replicas[(start + i) % n] for i in range(n)]
+        reps = list(self.replicas)
+        n = len(reps)
+        order = [reps[(start + i) % n] for i in range(n)]
         return [r for r in order
                 if r.index not in exclude and r.routable()]
 
@@ -332,9 +397,34 @@ class ServeRouter:
 
     def _note_failure(self, rep: Replica, exc: BaseException) -> None:
         self._count("transport_failures")
+        with self._stats_lock:
+            rep.window_served += 1
+            rep.window_errors += 1
         if rep.breaker.failure():
             self._event(rep.index, "breaker_open", port=rep.port,
                         error=repr(exc)[:200])
+
+    def _record_outcome(self, rep: Replica, ms: float, status: int) -> None:
+        """Per-replica window accounting (canary evidence) + the router's
+        latency views. A 5xx is the replica failing a request it accepted;
+        backpressure (429/503) and client errors are not regressions."""
+        with self._stats_lock:
+            self._latencies_ms.append(ms)
+            self._tick_lat.append(ms)
+            rep.window_served += 1
+            rep.window_lat_ms.append(ms)
+            if status >= 500:
+                rep.window_errors += 1
+
+    def take_tick_stats(self) -> dict:
+        """Drain the per-tick latency window: ``{"n", "p95_ms"}`` for the
+        requests routed since the previous call (p95_ms None on an idle
+        tick). The autoscaler's pressure signal."""
+        with self._stats_lock:
+            lat = self._tick_lat
+            self._tick_lat = []
+        return {"n": len(lat),
+                "p95_ms": round(percentile(lat, 0.95), 3) if lat else None}
 
     # ----------------------------------------------------------- idempotency
 
@@ -398,16 +488,13 @@ class ServeRouter:
             raise
         status, data, hdrs, rep = result
         if rep is not None:
-            self._with_latency((time.monotonic() - t0) * 1000.0)
+            self._record_outcome(rep, (time.monotonic() - t0) * 1000.0,
+                                 status)
             hdrs = dict(hdrs, **{"X-Served-By": str(rep.index)})
         if entry is not None:
             self._idem_publish(idem_key, entry,
                                (status, data, hdrs) if status == 200 else None)
         return status, data, dict(hdrs, **echo)
-
-    def _with_latency(self, ms: float) -> None:
-        with self._stats_lock:
-            self._latencies_ms.append(ms)
 
     def _dispatch(self, method, path, body, headers, idempotent, deadline):
         """(status, body, headers, replica-or-None) after retry/hedge."""
@@ -538,52 +625,147 @@ class ServeRouter:
         if not self._roll_lock.acquire(blocking=False):
             return 409, {"error": "a refresh roll is already in flight"}
         try:
+            prior = self._last_installed
+            canary_n = self.canary_requests
             if self.logger is not None:
                 self.logger.log("model_refresh", status="roll_started",
                                 tenant=spec.get("tenant"),
-                                step=spec.get("step"))
-            results = []
+                                step=spec.get("step"),
+                                canary_requests=canary_n)
+            results: list[dict] = []
+            canary_info = None
             body = json.dumps(spec).encode()
-            for rep in self.replicas:
+            for pos, rep in enumerate(self.active_replicas()):
                 if not rep.healthy:
                     # An unroutable replica cannot install; rolling past it
                     # would leave a torn fleet once it heals. Abort loudly.
                     results.append({"replica": rep.index,
                                     "status": "unreachable"})
                     return self._roll_verdict(409, spec, results)
-                try:
-                    status, data, _ = self._proxy_once(
-                        rep, "POST", "/v1/refresh", body,
-                        {"Content-Type": "application/json"},
-                        time.monotonic() + self.timeout_s)
-                except TRANSPORT_ERRORS as exc:
-                    self._note_failure(rep, exc)
-                    results.append({"replica": rep.index,
-                                    "status": "transport_error",
-                                    "detail": repr(exc)[:200]})
-                    return self._roll_verdict(502, spec, results)
-                try:
-                    payload = json.loads(data.decode() or "{}")
-                except ValueError:
-                    payload = {}
-                results.append({"replica": rep.index, "code": status,
-                                **payload})
-                if status != 200:
-                    return self._roll_verdict(status, spec, results)
-            return self._roll_verdict(200, spec, results)
+                err = self._refresh_one(rep, body, results)
+                if err is not None:
+                    return self._roll_verdict(err, spec, results)
+                if pos == 0 and canary_n:
+                    # Canary hold: the rest of the fleet still serves the
+                    # prior model; only this replica runs the new one.
+                    ok, canary_info = self._canary_hold(rep, canary_n)
+                    if not ok:
+                        rb = self._rollback_canary(rep, prior,
+                                                   spec.get("tenant"))
+                        if self.logger is not None:
+                            self.logger.log(
+                                "model_refresh", status="rolled_back",
+                                tenant=spec.get("tenant"),
+                                step=spec.get("step"), canary=canary_info,
+                                prior=prior, rollback=rb)
+                        return 409, {"status": "rolled_back",
+                                     "canary": canary_info, "prior": prior,
+                                     "rollback": rb, "replicas": results}
+            # Remember what landed (the replicas' resolved step — a
+            # stepless "newest durable" spec still pins a rollback target).
+            used = next((r.get("step") for r in results
+                         if r.get("step") is not None), None)
+            if used is not None:
+                self._last_installed = {"dir": spec.get("dir"), "step": used}
+            return self._roll_verdict(200, spec, results, canary=canary_info)
         finally:
             self._roll_lock.release()
 
-    def _roll_verdict(self, code: int, spec: dict,
-                      results: list) -> tuple[int, dict]:
+    def _refresh_one(self, rep: Replica, body: bytes,
+                     results: list) -> int | None:
+        """Install on one replica; appends its result and returns the abort
+        status code, or None on a clean install."""
+        try:
+            status, data, _ = self._proxy_once(
+                rep, "POST", "/v1/refresh", body,
+                {"Content-Type": "application/json"},
+                time.monotonic() + self.timeout_s)
+        except TRANSPORT_ERRORS as exc:
+            self._note_failure(rep, exc)
+            results.append({"replica": rep.index,
+                            "status": "transport_error",
+                            "detail": repr(exc)[:200]})
+            return 502
+        try:
+            payload = json.loads(data.decode() or "{}")
+        except ValueError:
+            payload = {}
+        results.append({"replica": rep.index, "code": status, **payload})
+        return None if status == 200 else status
+
+    def _canary_hold(self, rep: Replica, canary_n: int) -> tuple[bool, dict]:
+        """Hold the roll while the canary takes live traffic: wait for
+        ``canary_n`` requests attributed to it (bounded by
+        ``canary_timeout_s``), then judge its window against the fleet SLO
+        floors (``obs.slo.judge_canary``). Zero routed traffic inside the
+        bound is inconclusive — the roll proceeds, and says so."""
+        from ..obs.slo import judge_canary
+        with self._stats_lock:
+            rep.window_served = 0
+            rep.window_errors = 0
+            rep.window_lat_ms.clear()
+        deadline = time.monotonic() + self.canary_timeout_s
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                if rep.window_served >= canary_n:
+                    break
+            time.sleep(0.05)
+        with self._stats_lock:
+            served = rep.window_served
+            errors = rep.window_errors
+            lat = list(rep.window_lat_ms)
+        p95 = round(percentile(lat, 0.95), 3) if lat else None
+        info = {"replica": rep.index, "requests": served, "errors": errors,
+                "p95_ms": p95, "target_requests": canary_n,
+                "p95_floor_ms": self.canary_p95_floor_ms}
+        if served == 0:
+            info["verdict"] = "inconclusive_no_traffic"
+            return True, info
+        ok, reasons = judge_canary(
+            served=served, errors=errors, p95_ms=p95,
+            p95_floor_ms=self.canary_p95_floor_ms,
+            error_frac_floor=self.canary_error_frac)
+        info["verdict"] = "pass" if ok else "fail"
+        info["reasons"] = reasons
+        return ok, info
+
+    def _rollback_canary(self, rep: Replica, prior: dict | None,
+                         tenant: str | None) -> dict:
+        """Re-install the prior model on the failed canary. No known prior
+        (a first-ever roll) leaves the canary as-is — recorded honestly."""
+        if not prior or prior.get("step") is None:
+            return {"status": "no_prior"}
+        spec = {k: v for k, v in prior.items() if v is not None}
+        if tenant:
+            spec["tenant"] = tenant
+        body = json.dumps(spec).encode()
+        try:
+            status, data, _ = self._proxy_once(
+                rep, "POST", "/v1/refresh", body,
+                {"Content-Type": "application/json"},
+                time.monotonic() + self.timeout_s)
+        except TRANSPORT_ERRORS as exc:
+            self._note_failure(rep, exc)
+            return {"status": "transport_error", "detail": repr(exc)[:200]}
+        try:
+            payload = json.loads(data.decode() or "{}")
+        except ValueError:
+            payload = {}
+        return {"replica": rep.index, "code": status, **payload}
+
+    def _roll_verdict(self, code: int, spec: dict, results: list,
+                      canary: dict | None = None) -> tuple[int, dict]:
         ok = code == 200
         if self.logger is not None:
             self.logger.log("model_refresh",
                             status="roll_complete" if ok else "roll_aborted",
                             tenant=spec.get("tenant"), step=spec.get("step"),
-                            replicas=len(results))
-        return code, {"status": "rolled" if ok else "roll_aborted",
-                      "replicas": results}
+                            replicas=len(results), canary=canary)
+        out = {"status": "rolled" if ok else "roll_aborted",
+               "replicas": results}
+        if canary is not None:
+            out["canary"] = canary
+        return code, out
 
     # ---------------------------------------------------------------- views
 
@@ -592,20 +774,24 @@ class ServeRouter:
             return percentile(self._latencies_ms, 0.95)
 
     def available(self) -> int:
-        return sum(r.routable() for r in self.replicas)
+        return sum(r.routable() for r in self.active_replicas())
 
     def health(self) -> dict:
-        avail = self.available()
-        if self._draining:
+        active = self.active_replicas()
+        avail = sum(r.routable() for r in active)
+        if self.supervisor_faults:
+            status = "critical"
+            reasons = list(self.supervisor_faults)
+        elif self._draining:
             status, reasons = "critical", ["router draining"]
-        elif avail == len(self.replicas):
+        elif avail == len(active):
             status, reasons = "ok", []
         else:
             status = "critical" if avail == 0 else "degraded"
-            reasons = [f"{len(self.replicas) - avail} of "
-                       f"{len(self.replicas)} replicas unroutable"]
+            reasons = [f"{len(active) - avail} of "
+                       f"{len(active)} replicas unroutable"]
         return {"status": status, "available": avail,
-                "replicas": [r.view() for r in self.replicas],
+                "replicas": [r.view() for r in active],
                 "draining": self._draining, "reasons": reasons}
 
     def stats(self) -> dict:
@@ -613,10 +799,10 @@ class ServeRouter:
             counters = dict(self.counters)
             lat = list(self._latencies_ms)
         return {**counters, "available": self.available(),
-                "replicas": len(self.replicas),
+                "replicas": len(self.active_replicas()),
                 "p50_ms": round(percentile(lat, 0.50), 3),
                 "p95_ms": round(percentile(lat, 0.95), 3)}
 
     def status(self) -> dict:
         return {"router": self.stats(),
-                "replicas": [r.view() for r in self.replicas]}
+                "replicas": [r.view() for r in self.active_replicas()]}

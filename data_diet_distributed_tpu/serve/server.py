@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import threading
 import time
 from urllib.parse import parse_qs, urlsplit
@@ -58,7 +59,32 @@ def default_methods(cfg: Config) -> tuple[str, ...]:
 class _ServeHandler(obs_server._Handler):
     server_version = "ddt-serve/1"
 
+    def _fault_gate(self) -> bool:
+        """Injected network faults (``resilience/inject.py``): a
+        partitioned replica drops the connection without writing a byte —
+        /healthz included, so the fleet and the router see exactly what a
+        NIC drop looks like (a transport error, not an HTTP status) — and
+        a slow replica delays every response. Deliberately NOT a
+        hold-the-socket black hole: the peer fails fast instead of eating
+        its own request deadline. True = the request was eaten."""
+        from ..resilience import inject
+        if inject.serve_partitioned():
+            self.close_connection = True
+            with contextlib.suppress(OSError):
+                self.connection.shutdown(socket.SHUT_RDWR)
+            return True
+        owner = self.server.owner   # type: ignore[attr-defined]
+        service = getattr(owner, "service", None)
+        step = (service.model_steps.get(service.default_tenant)
+                if service is not None else None)
+        delay_ms = inject.serve_slow_ms(step)
+        if delay_ms:
+            time.sleep(delay_ms / 1e3)
+        return False
+
     def do_GET(self):   # noqa: N802 — http.server API
+        if self._fault_gate():
+            return
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/v1/topk":
             owner = self.server.owner   # type: ignore[attr-defined]
@@ -73,6 +99,8 @@ class _ServeHandler(obs_server._Handler):
         super().do_GET()
 
     def do_POST(self):   # noqa: N802 — http.server API
+        if self._fault_gate():
+            return
         owner = self.server.owner   # type: ignore[attr-defined]
         t0 = time.perf_counter()
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
@@ -172,6 +200,16 @@ class ServeServer(obs_server.StatusServer):
         them — and the verdict goes critical (503), which is exactly what
         the fleet router/supervisor key replica respawn off."""
         out = super().health()
+        # Load evidence for the fleet autoscaler: the supervisor's health
+        # poll carries each replica's queue depth and admission counters
+        # back to the control loop (the same signals check_serve judges).
+        b = self.service.batcher.stats()
+        out["serve_load"] = {
+            "queued": int(sum(b["queued"].values())),
+            "inflight": int(b["inflight"]),
+            "accepted": int(b["accepted"]),
+            "rejected": int(b["rejected"]),
+        }
         budget = self.service.cfg.serve.dispatch_stall_s
         age = self.service.batcher.dispatch_age_s()
         out["serve_watchdog"] = {

@@ -25,6 +25,16 @@ Three tiers, all tier-1:
   rejected with the fleet still on the old model, a good refresh rolled
   with capacity never zero, SIGTERM → exit 75, and the stream readable by
   validate_metrics / run_monitor / the postmortem timeline.
+* **the self-healing seams (ISSUE 16)** — breaker half-open under
+  concurrent probes, the all-replicas-dead honest bounded 503, autoscaler
+  hysteresis as pure logic, the remote launch line, supervisor thread
+  self-monitoring, the multi-endpoint failover client; plus three more
+  fleet drills: a network partition quarantined on probation with the
+  restart budget untouched (through the REMOTE backend against
+  127.0.0.1), SLO pressure growing the fleet and sustained idle shrinking
+  it with evidence-bearing ``autoscale_event`` records, and a regressed
+  checkpoint rolled back at the canary gate with the prior model serving
+  bit-identical scores.
 """
 
 import importlib.util
@@ -48,7 +58,8 @@ from data_diet_distributed_tpu.obs import MetricsLogger
 from data_diet_distributed_tpu.obs import slo as obs_slo
 from data_diet_distributed_tpu.obs import timeline as tl
 from data_diet_distributed_tpu.resilience.inject import truncate_checkpoint
-from data_diet_distributed_tpu.serve.fleet import discover_steps
+from data_diet_distributed_tpu.serve.fleet import (Autoscaler, ServeFleet,
+                                                   discover_steps)
 from data_diet_distributed_tpu.serve.router import (CircuitBreaker, Replica,
                                                     ServeRouter)
 
@@ -886,3 +897,670 @@ class TestFleetDrill:
         # never an unexplained run-level recovery chain.
         view = tl.lineage_view(drill["records"])
         assert view["attempts"] == 1 and view["unexplained"] == []
+
+
+# ======================================================================
+# ISSUE 16 unit seams: breaker probe races, partition-wide honesty,
+# autoscaler hysteresis, the remote launch line, supervisor
+# self-monitoring, and the multi-endpoint client.
+# ======================================================================
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """N callers race the half-open window; the breaker's probe slot
+    admits exactly one — the rest keep refusing instead of stampeding a
+    replica that just came back."""
+    b = CircuitBreaker(failures=1, reset_s=0.2)
+    b.failure()
+    assert b.state == "open"
+    for _round in range(2):
+        time.sleep(0.25)                 # reset elapsed: half-open
+        wins, barrier = [], threading.Barrier(8)
+
+        def race():
+            barrier.wait()
+            wins.append(b.acquire())
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sum(wins) == 1, wins
+        # The probe FAILS: re-opens, and the next half-open window again
+        # admits exactly one (the slot is per-window, not one-shot).
+        assert b.failure() is True and b.state == "open"
+
+
+def test_all_replicas_dead_converges_to_bounded_503(fakes):
+    """Every replica unreachable (the all-partitioned worst case): after
+    the breakers open, keyed POSTs get a FAST honest 503 + Retry-After —
+    never an unbounded retry storm or a hang."""
+    for f in fakes:
+        f.kill()
+    # A long breaker reset keeps the open state stable through the
+    # asserts (a short one half-opens and the corpse looks routable).
+    router = _mk_router(fakes, retries=1, retry_after_s=1.5,
+                        breaker_reset_s=30.0)
+    try:
+        t0 = time.monotonic()
+        for i in range(6):
+            code, body, headers = _req(router, key=f"k-part-{i}")
+            # EVERY request is the honest refusal — the failover loop
+            # exhausts the dead candidates within the request, long
+            # before the breakers even open.
+            assert code == 503, (code, body)
+            assert "no routable replica" in body["error"]
+            assert headers.get("Retry-After") == "1.5"
+        wall = time.monotonic() - t0
+        # ... and once the consecutive failures accrue, both breakers
+        # latch open: the fleet reads 0 available / critical.
+        assert {r.breaker.state for r in router.replicas} == {"open"}
+        assert router.counters["no_replica"] >= 6
+        assert router.available() == 0
+        assert router.health()["status"] == "critical"
+        assert wall < 20, wall   # refused-fast, not timeout-by-timeout
+    finally:
+        router.stop()
+
+
+# ------------------------------------------------------------- autoscaler
+
+def _as(**kw):
+    defaults = dict(min_replicas=1, max_replicas=3, up_after=2,
+                    down_after=3, cooldown_s=10.0, p95_floor_ms=100.0)
+    defaults.update(kw)
+    return Autoscaler(**defaults)
+
+
+HOT = {"p95_ms": 250.0, "requests": 40, "queue_depth": 0,
+       "reject_frac": 0.0}
+IDLE = {"p95_ms": None, "requests": 0, "queue_depth": 0,
+        "reject_frac": 0.0}
+STEADY = {"p95_ms": 80.0, "requests": 40, "queue_depth": 0,
+          "reject_frac": 0.0}
+
+
+def test_autoscaler_scale_up_needs_sustained_pressure():
+    a = _as()
+    assert a.evaluate(now=0.0, replicas=1, routable=1, ev=HOT) is None
+    d = a.evaluate(now=1.0, replicas=1, routable=1, ev=HOT)
+    assert d["action"] == "scale_up"
+    assert any("p95" in r for r in d["reasons"]), d
+
+
+def test_autoscaler_steady_load_resets_both_counters():
+    a = _as()
+    a.evaluate(now=0.0, replicas=1, routable=1, ev=HOT)
+    a.evaluate(now=1.0, replicas=1, routable=1, ev=STEADY)
+    # The streak restarted: one more hot tick is NOT enough again.
+    assert a.evaluate(now=2.0, replicas=1, routable=1, ev=HOT) is None
+    a2 = _as()
+    a2.evaluate(now=0.0, replicas=2, routable=2, ev=IDLE)
+    a2.evaluate(now=1.0, replicas=2, routable=2, ev=IDLE)
+    a2.evaluate(now=2.0, replicas=2, routable=2, ev=STEADY)
+    assert a2.evaluate(now=3.0, replicas=2, routable=2, ev=IDLE) is None
+    assert a2.evaluate(now=4.0, replicas=2, routable=2, ev=IDLE) is None
+    d = a2.evaluate(now=5.0, replicas=2, routable=2, ev=IDLE)
+    assert d["action"] == "scale_down"
+
+
+def test_autoscaler_cooldown_blocks_back_to_back_actions():
+    a = _as(cooldown_s=30.0)
+    a.evaluate(now=0.0, replicas=1, routable=1, ev=HOT)
+    assert a.evaluate(now=1.0, replicas=1, routable=1,
+                      ev=HOT)["action"] == "scale_up"
+    for t in (2.0, 3.0, 4.0):   # still violating, but inside the cooldown
+        assert a.evaluate(now=t, replicas=2, routable=2, ev=HOT) is None
+    d = a.evaluate(now=40.0, replicas=2, routable=2, ev=HOT)
+    assert d["action"] == "scale_up"
+
+
+def test_autoscaler_at_max_surfaces_instead_of_overgrowing():
+    a = _as(max_replicas=2)
+    a.evaluate(now=0.0, replicas=2, routable=2, ev=HOT)
+    d = a.evaluate(now=1.0, replicas=2, routable=2, ev=HOT)
+    assert d["action"] == "at_max" and d["reasons"], d
+
+
+def test_autoscaler_scale_down_refused_at_floor_and_when_unroutable():
+    a = _as(min_replicas=2)
+    for t in (0.0, 1.0, 2.0, 3.0):
+        d = a.evaluate(now=t, replicas=2, routable=2, ev=IDLE)
+    assert d is None   # idle AT the floor is simply fine
+    a2 = _as(min_replicas=1)
+    for t in (0.0, 1.0, 2.0, 3.0):
+        # N-1 discipline: never start a drain while a replica is already
+        # unroutable, no matter how long the fleet has been idle. The
+        # deferred tick CONSUMES the streak — headroom must re-accumulate.
+        assert a2.evaluate(now=t, replicas=2, routable=1, ev=IDLE) is None
+    assert a2.evaluate(now=4.0, replicas=2, routable=2, ev=IDLE) is None
+    d = a2.evaluate(now=5.0, replicas=2, routable=2, ev=IDLE)
+    assert d["action"] == "scale_down"
+
+
+def test_autoscaler_pressure_names_every_violated_floor():
+    a = _as(queue_floor=4, reject_frac_floor=0.05)
+    reasons = a.pressure({"p95_ms": 300.0, "queue_depth": 9,
+                          "reject_frac": 0.5, "requests": 10})
+    assert len(reasons) == 3
+    joined = " ".join(reasons)
+    assert "p95" in joined and "queue" in joined and "reject" in joined
+
+
+# ----------------------------------------------------- remote launch line
+
+def test_remote_argv_carries_env_and_never_rearms_fault_plan(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("DDT_FAULT_PLAN", '{"rank": 0}')
+    cfg = _cfg(tmp_path, "serve.replicas=2",
+               "serve.hosts=[hostA,hostB]",
+               "serve.remote_launch='ssh -T {host}'")
+    fleet = ServeFleet(cfg, logger=None)
+    argv = fleet._remote_argv(1, 0, "hostB")
+    assert argv[:3] == ["ssh", "-T", "hostB"]     # template, {host} filled
+    py = argv.index(sys.executable)
+    carried = argv[argv.index("env") + 1:py]
+    # The child's identity and the gen-0 fault plan ride as env tokens.
+    assert "DDT_SERVE_REPLICA=1" in carried
+    assert any(t.startswith("DDT_FAULT_PLAN=") for t in carried)
+    assert any(t.startswith("PYTHONPATH=") for t in carried)
+    tail = argv[py:]
+    assert f"serve.port={fleet.ports[1]}" in tail
+    assert "serve.host=hostB" in tail             # the slot binds its host
+    # A child is one fixed replica: the operator's autoscaler bounds and
+    # refresh watcher never recurse into it.
+    assert "serve.replicas=1" in tail
+    assert "serve.min_replicas=null" in tail
+    assert "serve.max_replicas=null" in tail
+    assert "serve.refresh_poll_s=null" in tail
+    # A respawn UNSETS the plan on the remote side — ssh semantics and a
+    # local /usr/bin/env template must agree.
+    argv1 = fleet._remote_argv(1, 1, "hostB")
+    assert argv1[argv1.index("env"):][:3] == ["env", "-u", "DDT_FAULT_PLAN"]
+    assert not any(t.startswith("DDT_FAULT_PLAN=") for t in argv1)
+
+
+# ------------------------------------------- supervisor self-monitoring
+
+def test_dead_supervisor_thread_flips_healthz_critical(tmp_path):
+    cfg = _cfg(tmp_path, "serve.replicas=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    fleet = ServeFleet(cfg, logger=logger)
+    t = threading.Thread(target=lambda: None, name="health_poll_loop")
+    t.start()
+    t.join()
+    fleet._threads.append(t)
+    fleet._check_threads()
+    assert fleet.router.supervisor_faults
+    health = fleet.router.health()
+    assert health["status"] == "critical"
+    assert any("health_poll_loop" in r for r in health["reasons"])
+    fleet._check_threads()   # first sighting only: no duplicate epitaphs
+    assert len(fleet.router.supervisor_faults) == 1
+    logger.close()
+    recs = [r for r in _stream_recs(cfg.obs.metrics_path)
+            if r.get("kind") == "replica_event"
+            and r.get("event") == "supervisor_thread_dead"]
+    assert len(recs) == 1
+    assert recs[0]["replica"] is None      # the casualty IS the supervisor
+    assert recs[0]["thread"] == "health_poll_loop"
+    vm = _load_tool("validate_metrics")
+    assert vm.validate_file(str(cfg.obs.metrics_path)) == []
+
+
+# ------------------------------------------------- multi-endpoint client
+
+def _free_url():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def test_client_single_url_signature_and_comma_list():
+    sc = _load_tool("serve_client")
+    c = sc.ServeClient("http://127.0.0.1:9/")
+    assert c.endpoints == ["http://127.0.0.1:9"]
+    assert c.base == "http://127.0.0.1:9"
+    c2 = sc.ServeClient("http://a:1, http://b:2/")
+    assert c2.endpoints == ["http://a:1", "http://b:2"]
+    with pytest.raises(ValueError):
+        sc.ServeClient([])
+
+
+def test_client_rotates_to_live_endpoint_without_retry_budget(fakes):
+    """A dead first endpoint costs NOTHING: the client rotates to the
+    sibling router free of the retry budget, and stays pinned there."""
+    sc = _load_tool("serve_client")
+    router = _mk_router(fakes)
+    dead = _free_url()
+    try:
+        client = sc.ServeClient([dead, f"http://127.0.0.1:{router.port}"],
+                                timeout_s=15.0, retries=0)
+        out = client.score(indices=[0])
+        assert out["served_by"] in (0, 1)
+        assert client.failovers == 1 and client.retry_count == 0
+        assert client.base.endswith(str(router.port))
+        client.score(indices=[1])
+        assert client.failovers == 1   # sticky: no re-probe of the corpse
+    finally:
+        router.stop()
+
+
+def test_client_503_rotates_to_sibling_router(fakes):
+    sc = _load_tool("serve_client")
+    router_a = _mk_router(fakes[:1])
+    router_b = _mk_router(fakes[1:])
+    try:
+        router_a.stop_admission()      # draining: an honest 503
+        client = sc.ServeClient(
+            [f"http://127.0.0.1:{router_a.port}",
+             f"http://127.0.0.1:{router_b.port}"],
+            timeout_s=15.0, retries=0)
+        out = client.score(indices=[0])
+        assert out["served_by"] == 1
+        assert client.failovers == 1 and client.retry_count == 0
+    finally:
+        router_a.stop()
+        router_b.stop()
+
+
+# ======================================================================
+# ISSUE 16 fleet drills (real `cli serve` subprocesses): partition
+# probation through the remote backend, SLO-driven autoscaling, and the
+# canary-gated continuous deployment rollback.
+# ======================================================================
+
+_FLEET_ARGS = [
+    "data.dataset=synthetic", "data.synthetic_size=256",
+    "data.batch_size=64", "model.arch=tiny_cnn",
+    "train.half_precision=false", "score.pretrain_epochs=0",
+    "score.batch_size=64", "score.method=el2n",
+    "serve.router_port=0", "serve.port=0", "serve.tenant=tiny",
+    "serve.coalesce_ms=2", "serve.warm=false",
+    "serve.health_poll_s=0.25", "serve.breaker_reset_s=0.5",
+    "serve.request_timeout_s=120",
+    "elastic.max_restarts=4", "elastic.backoff_s=0.2"]
+
+
+def _drill_env(plan):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=str(REPO),
+               DDT_FAULT_PLAN=json.dumps(plan))
+    return env
+
+
+def _launch_fleet(tmp_path, env, *extra):
+    metrics = tmp_path / "metrics.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+         *_FLEET_ARGS,
+         f"obs.metrics_path={metrics}",
+         f"obs.heartbeat_dir={tmp_path}/hb",
+         f"train.checkpoint_dir={tmp_path}/ckpt", *extra],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    return proc, metrics
+
+
+def _router_url(proc, metrics, budget_s=120):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        if metrics.exists():
+            for rec in _stream_recs(metrics):
+                if rec.get("kind") == "serve_fleet" \
+                        and rec.get("event") == "launch":
+                    return f"http://127.0.0.1:{rec['router_port']}"
+        time.sleep(0.25)
+    raise AssertionError("fleet never published its router port")
+
+
+def _wait_available(proc, probe, sc, n, budget_s):
+    deadline = time.monotonic() + budget_s
+    verdict = None
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        try:
+            verdict = probe.healthz()
+        except sc.ServeError:
+            verdict = None
+        if verdict and verdict.get("available") == n:
+            return verdict
+        time.sleep(0.25)
+    raise AssertionError(f"fleet never reached {n} available: {verdict}")
+
+
+def _wait_record(proc, metrics, pred, what, budget_s):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        for rec in _stream_recs(metrics):
+            if pred(rec):
+                return rec
+        time.sleep(0.4)
+    raise AssertionError(f"no {what} record within {budget_s}s")
+
+
+class TestPartitionDrill:
+    """A network partition is probation, never a respawn. Replica 1's
+    socket goes dark mid-load (process alive): the supervisor
+    quarantines it behind the breaker, re-probes with bounded backoff,
+    and reconnects — zero client-visible failures, restart budget
+    untouched. Runs through the REMOTE replica backend (serve.hosts +
+    serve.remote_launch against 127.0.0.1): the genuine cross-host
+    launch line, exercised locally."""
+
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        tmp_path = tmp_path_factory.mktemp("partition_drill")
+        env = _drill_env({"rank": 1, "partition_replica_after": 2,
+                          "partition_seconds": 3.0})
+        proc, metrics = _launch_fleet(
+            tmp_path, env,
+            "serve.replicas=2",
+            "serve.hosts=[127.0.0.1]",
+            "serve.remote_launch='/usr/bin/env DDT_REMOTE_HOST={host}'",
+            "serve.partition_after_misses=2",
+            "serve.probe_backoff_s=0.25", "serve.probe_backoff_max_s=1.0",
+            "serve.stats_every_s=2")
+        sc = _load_tool("serve_client")
+        out = dict(metrics=metrics)
+        try:
+            url = _router_url(proc, metrics)
+            probe = sc.ServeClient(url, timeout_s=10.0)
+            _wait_available(proc, probe, sc, 2, 240)
+            out["load"] = sc.load_generate(
+                url, rps=10, duration_s=8, batch=8, max_index=255,
+                timeout_s=120, retries=6, backoff_s=0.25)
+            out["reconnected"] = _wait_record(
+                proc, metrics,
+                lambda r: r.get("kind") == "replica_event"
+                and r.get("event") == "reconnected", "reconnected", 90)
+            _wait_available(proc, probe, sc, 2, 120)
+            proc.send_signal(signal.SIGTERM)
+            out["rc"] = proc.wait(timeout=120)
+            out["stdout"] = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out["records"] = _stream_recs(metrics)
+        return out
+
+    def test_zero_client_visible_failures_through_partition(self, drill):
+        load = drill["load"]
+        assert load["errors"] == 0, (load, drill["stdout"][-4000:])
+        assert load["rejected"] == 0, load
+        assert "failovers" in load      # the report's new column
+
+    def test_partition_is_probation_not_a_death(self, drill):
+        revs = [r for r in drill["records"]
+                if r.get("kind") == "replica_event"]
+        parts = [r for r in revs if r["event"] == "partitioned"]
+        assert parts and parts[0]["replica"] == 1
+        assert parts[0]["misses"] >= 2
+        probes = [r for r in revs if r["event"] == "probation_probe"]
+        assert probes and all(r["replica"] == 1 for r in probes)
+        assert all(r["next_probe_s"] <= 1.0 for r in probes)   # bounded
+        # The partition was never mistaken for a death.
+        assert not [r for r in revs if r["event"] in ("died", "respawn")]
+
+    def test_reconnect_clears_quarantine_budget_untouched(self, drill):
+        rec = drill["reconnected"]
+        assert rec["replica"] == 1
+        assert rec["restarts_left"] == 4    # NOT a penny of restart budget
+        assert rec["outage_s"] > 0 and rec["probes"] >= 1
+
+    def test_remote_backend_spawned_every_slot_on_its_host(self, drill):
+        spawns = [r for r in drill["records"]
+                  if r.get("kind") == "replica_event"
+                  and r.get("event") == "spawn"]
+        assert len(spawns) == 2
+        assert all(r.get("host") == "127.0.0.1" for r in spawns)
+
+    def test_terminal_stream_valid_and_monitor_clean(self, drill):
+        assert drill["rc"] == 75, drill["stdout"][-4000:]
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_file(str(drill["metrics"]),
+                                    expect_terminal=True)
+        assert problems == [], problems
+        mon = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "run_monitor.py"),
+             "--metrics", str(drill["metrics"]), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert mon.returncode == 0, mon.stdout + mon.stderr
+        view = json.loads(mon.stdout.strip().splitlines()[-1])
+        sf = view["serve_fleet"]
+        assert sf["partitioned"] >= 1 and sf["reconnected"] >= 1
+
+
+class TestAutoscaleDrill:
+    """SLO pressure grows the fleet, sustained idle shrinks it — with
+    hysteresis, cooldown, and evidence on every decision. Starts at
+    replicas=1 with serve.max_replicas=2: an autoscaled fleet is a fleet
+    even at N=1 (the widened cli gate)."""
+
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        tmp_path = tmp_path_factory.mktemp("autoscale_drill")
+        env = _drill_env({"rank": 0, "slow_replica_ms": 400.0})
+        proc, metrics = _launch_fleet(
+            tmp_path, env,
+            "serve.replicas=1", "serve.max_replicas=2",
+            "serve.scale_up_after=2", "serve.scale_down_after=3",
+            "serve.scale_cooldown_s=3", "serve.stats_every_s=1",
+            "obs.slo_fleet_p95_ms=150")
+        sc = _load_tool("serve_client")
+        out = dict(metrics=metrics)
+        try:
+            url = _router_url(proc, metrics)
+            probe = sc.ServeClient(url, timeout_s=10.0)
+            _wait_available(proc, probe, sc, 1, 240)
+            out["load"] = sc.load_generate(
+                url, rps=10, duration_s=8, batch=8, max_index=255,
+                timeout_s=120, retries=6, backoff_s=0.25)
+            out["scale_up"] = _wait_record(
+                proc, metrics,
+                lambda r: r.get("kind") == "autoscale_event"
+                and r.get("action") == "scale_up", "scale_up", 90)
+            out["scale_down"] = _wait_record(
+                proc, metrics,
+                lambda r: r.get("kind") == "autoscale_event"
+                and r.get("action") == "scale_down", "scale_down", 240)
+            _wait_available(proc, probe, sc, 1, 120)
+            proc.send_signal(signal.SIGTERM)
+            out["rc"] = proc.wait(timeout=120)
+            out["stdout"] = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out["records"] = _stream_recs(metrics)
+        return out
+
+    def test_load_survives_the_pressure_episode(self, drill):
+        load = drill["load"]
+        assert load["errors"] == 0, (load, drill["stdout"][-4000:])
+
+    def test_scale_up_carries_evidence_and_respects_max(self, drill):
+        rec = drill["scale_up"]
+        assert rec["replicas_from"] == 1 and rec["replicas_to"] == 2
+        assert rec["replicas_to"] <= rec["max_replicas"]
+        assert rec["reasons"] and any("p95" in r for r in rec["reasons"])
+        assert rec["evidence"]["p95_ms"] > 150
+        spawns = [r for r in drill["records"]
+                  if r.get("kind") == "replica_event"
+                  and r.get("event") == "spawn"
+                  and r.get("cause") == "autoscale"]
+        assert spawns and spawns[0]["replica"] == 1
+
+    def test_idle_scales_back_down_to_the_floor(self, drill):
+        rec = drill["scale_down"]
+        assert rec["replicas_from"] == 2 and rec["replicas_to"] == 1
+        assert rec["replicas_to"] >= rec["min_replicas"]
+        assert any("headroom" in r for r in rec["reasons"])
+        retired = [r for r in drill["records"]
+                   if r.get("kind") == "replica_event"
+                   and r.get("event") == "retired"]
+        assert retired and retired[0].get("cause") == "autoscale"
+
+    def test_no_flapping(self, drill):
+        acts = [r["action"] for r in drill["records"]
+                if r.get("kind") == "autoscale_event"
+                and r.get("action") in ("scale_up", "scale_down")]
+        # One grow episode, then one shrink — never an up after the down.
+        assert acts.count("scale_up") == 1
+        assert acts[-1] == "scale_down"
+
+    def test_stream_monitor_and_timeline_see_the_autoscale(self, drill):
+        assert drill["rc"] == 75, drill["stdout"][-4000:]
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_file(str(drill["metrics"]),
+                                    expect_terminal=True)
+        assert problems == [], problems
+        mon = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "run_monitor.py"),
+             "--metrics", str(drill["metrics"]), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        # Exit 1 is HONEST here: the injected slowness produced real
+        # slo_violation records alongside the autoscale response.
+        assert mon.returncode == 1, mon.stdout + mon.stderr
+        view = json.loads(mon.stdout.strip().splitlines()[-1])
+        assert view["autoscale"]["scale_ups"] >= 1
+        assert view["autoscale"]["scale_downs"] >= 1
+        assert view["autoscale"]["replicas"] == 1
+        events = tl.build_timeline({"records": drill["records"]})
+        assert any(e["kind"] == "autoscale_event"
+                   and e.get("action") == "scale_up" for e in events)
+
+
+class TestCanaryDrill:
+    """Continuous deployment with a canary gate: a good checkpoint
+    landing in the watched stream rolls to the whole fleet; a REGRESSED
+    one (slow past the fleet p95 floor, keyed on its model step by the
+    fault plan) is caught on the first canary replica and rolled back —
+    the prior model keeps serving bit-identical scores."""
+
+    IDS = [3, 7, 10, 200, 5]
+
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        import jax
+
+        from data_diet_distributed_tpu.checkpoint import CheckpointManager
+        from data_diet_distributed_tpu.train.state import create_train_state
+        tmp_path = tmp_path_factory.mktemp("canary_drill")
+        cfg = _cfg(tmp_path)
+        watch = tmp_path / "watched"
+        env = _drill_env({"rank": 0, "slow_replica_ms": 600.0,
+                          "slow_if_step": 999})
+        proc, metrics = _launch_fleet(
+            tmp_path, env,
+            "serve.replicas=2",
+            f"serve.refresh_from={watch}",
+            "serve.refresh_poll_s=0.5",
+            "serve.canary_requests=4", "serve.canary_timeout_s=10",
+            "serve.stats_every_s=2",
+            "obs.slo_fleet_p95_ms=150")
+        sc = _load_tool("serve_client")
+        out = dict(metrics=metrics)
+        try:
+            url = _router_url(proc, metrics)
+            probe = sc.ServeClient(url, timeout_s=10.0)
+            client = sc.ServeClient(url, timeout_s=300.0, retries=6,
+                                    backoff_s=0.25)
+            _wait_available(proc, probe, sc, 2, 240)
+            out["burst_errors"] = 0
+
+            def burst_until(pred, what, budget_s):
+                # The canary hold judges ROUTED requests — keep offering
+                # traffic until the awaited record lands.
+                deadline = time.monotonic() + budget_s
+                while time.monotonic() < deadline:
+                    assert proc.poll() is None, proc.stdout.read()[-4000:]
+                    for rec in _stream_recs(metrics):
+                        if pred(rec):
+                            return rec
+                    load = sc.load_generate(
+                        url, rps=8, duration_s=2, batch=8, max_index=255,
+                        timeout_s=120, retries=6, backoff_s=0.25)
+                    out["burst_errors"] += load["errors"]
+                raise AssertionError(f"no {what} within {budget_s}s")
+
+            # A GOOD model lands in the watched stream: canary passes,
+            # the roll completes fleet-wide.
+            _save_state(cfg, tmp_path, "watched", seed=5, step=10)
+            out["roll10"] = burst_until(
+                lambda r: r.get("kind") == "model_refresh"
+                and r.get("status") == "roll_complete"
+                and r.get("step") == 10, "roll_complete step 10", 120)
+            out["baseline"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            # A REGRESSED model lands: step 999 violates the p95 floor
+            # under the canary's own routed traffic.
+            state = create_train_state(cfg, jax.random.key(9),
+                                       steps_per_epoch=4)
+            mngr = CheckpointManager(str(watch))
+            mngr.save(999, state)
+            mngr.close()
+            out["rolled_back"] = burst_until(
+                lambda r: r.get("kind") == "model_refresh"
+                and r.get("status") == "rolled_back", "rolled_back", 120)
+            out["after"] = np.asarray(
+                client.score(indices=self.IDS)["scores"], np.float32)
+            proc.send_signal(signal.SIGTERM)
+            out["rc"] = proc.wait(timeout=120)
+            out["stdout"] = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out["records"] = _stream_recs(metrics)
+        return out
+
+    def test_good_model_rolls_fleet_wide_with_zero_failures(self, drill):
+        assert drill["burst_errors"] == 0, drill["stdout"][-4000:]
+        assert drill["roll10"]["step"] == 10
+        installs = [r for r in drill["records"]
+                    if r.get("kind") == "model_refresh"
+                    and r.get("status") == "installed"
+                    and r.get("step") == 10]
+        # Both replicas took the good model (a third step-10 install is
+        # the rollback restoring it on the canary later).
+        assert {r["replica"] for r in installs} == {0, 1}
+
+    def test_regressed_model_rolled_back_at_the_canary(self, drill):
+        rec = drill["rolled_back"]
+        assert rec["step"] == 999
+        canary = rec["canary"]
+        assert canary["verdict"] == "fail"
+        assert any("p95" in r for r in canary["reasons"]), canary
+        assert rec["prior"]["step"] == 10
+        # The regression never reached the full fleet.
+        assert not [r for r in drill["records"]
+                    if r.get("kind") == "model_refresh"
+                    and r.get("status") == "roll_complete"
+                    and r.get("step") == 999]
+
+    def test_prior_model_serves_bit_identical_after_rollback(self, drill):
+        np.testing.assert_array_equal(drill["after"], drill["baseline"])
+
+    def test_terminal_stream_valid_with_rollback_visible(self, drill):
+        assert drill["rc"] == 75, drill["stdout"][-4000:]
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_file(str(drill["metrics"]),
+                                    expect_terminal=True)
+        assert problems == [], problems
+        mon = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "run_monitor.py"),
+             "--metrics", str(drill["metrics"]), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert mon.returncode in (0, 1), mon.stdout + mon.stderr
+        view = json.loads(mon.stdout.strip().splitlines()[-1])
+        assert view["serve_fleet"]["refresh_rolled_back"] >= 1

@@ -125,7 +125,7 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                                       "fleet_status", "summary",
                                       "elastic_event", "soak_report",
                                       "serve_fleet", "replica_event",
-                                      "model_refresh"))
+                                      "model_refresh", "autoscale_event"))
         view = None
         if lineage:
             from data_diet_distributed_tpu.obs.timeline import (lineage_view,
@@ -188,15 +188,38 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                               for r in replica_events),
                 "wedged": sum(r.get("event") == "wedged"
                               for r in replica_events),
+                "partitioned": sum(r.get("event") == "partitioned"
+                                   for r in replica_events),
+                "reconnected": sum(r.get("event") == "reconnected"
+                                   for r in replica_events),
                 "refreshes": sum(r.get("status") == "installed"
                                  for r in refresh),
                 "refresh_rejected": sum(r.get("status") == "rejected"
                                         for r in refresh),
+                "refresh_rolled_back": sum(r.get("status") == "rolled_back"
+                                           for r in refresh),
                 "last": (serve_fleet[-1].get("event")
                          if serve_fleet else None),
                 "available": (stats[-1].get("available")
                               if stats else None),
                 "p95_ms": stats[-1].get("p95_ms") if stats else None,
+            }
+        autoscale = [r for r in recs if r.get("kind") == "autoscale_event"]
+        if autoscale:
+            # Display-only, like the elastic block: a fleet that resized
+            # within its bounds is doing its job, not violating anything.
+            last = autoscale[-1]
+            out["autoscale"] = {
+                "events": len(autoscale),
+                "scale_ups": sum(r.get("action") == "scale_up"
+                                 for r in autoscale),
+                "scale_downs": sum(r.get("action") == "scale_down"
+                                   for r in autoscale),
+                "at_max": sum(r.get("action") == "at_max"
+                              for r in autoscale),
+                "last": last.get("action"),
+                "replicas": last.get("replicas_to"),
+                "last_reasons": last.get("reasons"),
             }
         soak = [r for r in recs if r.get("kind") == "soak_report"]
         if soak:
@@ -327,10 +350,20 @@ def render(info: dict) -> str:
     if sf:
         lines.append(f"serve fleet: {sf['events']} event(s) — "
                      f"{sf['deaths']} death(s) / {sf['wedged']} wedged / "
-                     f"{sf['respawns']} respawn(s); refreshes "
+                     f"{sf['respawns']} respawn(s) / "
+                     f"{sf.get('partitioned', 0)} partition(s) "
+                     f"({sf.get('reconnected', 0)} reconnected); refreshes "
                      f"{sf['refreshes']} (+{sf['refresh_rejected']} "
-                     f"rejected) available={sf['available']} "
+                     f"rejected, {sf.get('refresh_rolled_back', 0)} rolled "
+                     f"back) available={sf['available']} "
                      f"p95={_fmt(sf['p95_ms'])}ms")
+    asc = info.get("autoscale")
+    if asc:
+        reasons = "; ".join(asc.get("last_reasons") or []) or "-"
+        lines.append(f"autoscale: {asc['events']} decision(s) — "
+                     f"{asc['scale_ups']} up / {asc['scale_downs']} down / "
+                     f"{asc['at_max']} at-max; last={asc['last']} "
+                     f"replicas={asc['replicas']} ({reasons})")
     lin = info.get("lineage")
     if lin:
         lines.append(f"lineage: {lin['attempts']} attempt(s), worlds "

@@ -5,6 +5,7 @@ operators)::
 
     from serve_client import ServeClient
     c = ServeClient("http://127.0.0.1:8788")
+    c = ServeClient(["http://hostA:8788", "http://hostB:8788"])  # failover
     c.score(indices=[3, 7, 10], method="el2n")      # -> {"scores": [...]}
     c.rank(indices=[0, 1, 2, 3])                    # hardest-first
     list(c.topk(k=10, method="grand"))              # streamed (index, score)
@@ -60,15 +61,41 @@ class ServeClient:
     (``backoff_s`` doubling, capped at 5 s). Every POST carries an
     ``Idempotency-Key`` — minted once per logical call and REUSED across
     its retries, so the router's replay cache guarantees a retried
-    ``/v1/score`` is never dispatched twice."""
+    ``/v1/score`` is never dispatched twice.
 
-    def __init__(self, base_url: str, timeout_s: float = 60.0,
+    ``base_url`` may also be a list of router endpoints (or one
+    comma-separated string): the client pins to one endpoint and rotates
+    to the next on a transport failure or 503 — a FREE failover that
+    consumes no retry budget and sleeps nothing, because a sibling
+    router is expected to be healthy right now. Only once every
+    endpoint has been tried for the logical call does the normal
+    retry/backoff schedule engage. The rotation is sticky: subsequent
+    calls start from whichever endpoint last worked."""
+
+    def __init__(self, base_url, timeout_s: float = 60.0,
                  retries: int = 0, backoff_s: float = 0.25):
-        self.base = base_url.rstrip("/")
+        if isinstance(base_url, str):
+            urls = [u for u in base_url.split(",") if u.strip()]
+        else:
+            urls = list(base_url)
+        if not urls:
+            raise ValueError("ServeClient needs at least one endpoint")
+        self.endpoints = [u.strip().rstrip("/") for u in urls]
+        self._ep = 0
+        self.failovers = 0       # endpoint rotations performed (load report)
         self.timeout_s = timeout_s
         self.retries = max(0, int(retries))
         self.backoff_s = float(backoff_s)
         self.retry_count = 0     # total retries performed (load report)
+
+    @property
+    def base(self) -> str:
+        """The endpoint current requests are pinned to."""
+        return self.endpoints[self._ep]
+
+    def _rotate(self) -> None:
+        self._ep = (self._ep + 1) % len(self.endpoints)
+        self.failovers += 1
 
     # ------------------------------------------------------------ plumbing
 
@@ -79,6 +106,7 @@ class ServeClient:
         if data is not None:
             headers["Idempotency-Key"] = idempotency_key or uuid.uuid4().hex
         attempt = 0
+        eps_tried = 1   # endpoints exercised since the last budgeted retry
         while True:
             req = urllib.request.Request(f"{self.base}{path}", data=data,
                                          headers=dict(headers))
@@ -93,19 +121,31 @@ class ServeClient:
                     body = {"error": str(err)}
                 retry_after = err.headers.get("Retry-After")
                 retry_after_s = float(retry_after) if retry_after else None
+                if err.code == 503 and eps_tried < len(self.endpoints):
+                    # This router is down/draining; a sibling may not be.
+                    # Rotating is free — no budget, no sleep.
+                    eps_tried += 1
+                    self._rotate()
+                    continue
                 if err.code in (429, 503) and attempt < self.retries:
                     # Backpressure with a hint: honor the server's own
                     # Retry-After over our backoff schedule.
                     attempt += 1
                     self.retry_count += 1
+                    eps_tried = 1
                     time.sleep(retry_after_s if retry_after_s is not None
                                else self._backoff(attempt))
                     continue
                 raise ServeError(err.code, body, retry_after_s) from None
             except (urllib.error.URLError, OSError) as err:
+                if eps_tried < len(self.endpoints):
+                    eps_tried += 1
+                    self._rotate()
+                    continue
                 if attempt < self.retries:
                     attempt += 1
                     self.retry_count += 1
+                    eps_tried = 1
                     time.sleep(self._backoff(attempt))
                     continue
                 raise ServeError(0, {"error": f"transport: {err}"}) from None
@@ -150,6 +190,7 @@ class ServeClient:
         if method:
             qs += f"&method={method}"
         attempt = 0
+        eps_tried = 1
         while True:
             req = urllib.request.Request(f"{self.base}/v1/topk?{qs}")
             try:
@@ -159,11 +200,20 @@ class ServeClient:
                     body = json.load(err)
                 except Exception:   # noqa: BLE001
                     body = {"error": str(err)}
+                if err.code == 503 and eps_tried < len(self.endpoints):
+                    eps_tried += 1
+                    self._rotate()
+                    continue
                 raise ServeError(err.code, body) from None
             except (urllib.error.URLError, OSError) as err:
+                if eps_tried < len(self.endpoints):
+                    eps_tried += 1
+                    self._rotate()
+                    continue
                 if attempt < self.retries:
                     attempt += 1
                     self.retry_count += 1
+                    eps_tried = 1
                     time.sleep(self._backoff(attempt))
                     continue
                 raise ServeError(0, {"error": f"transport: {err}"}) from None
@@ -262,7 +312,7 @@ def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
     return {
         "sent": n_sent, "ok": outcomes["ok"],
         "rejected": outcomes["rejected"], "errors": outcomes["errors"],
-        "retried": client.retry_count,
+        "retried": client.retry_count, "failovers": client.failovers,
         "offered_rps": round(rps, 2),
         "achieved_rps": round(outcomes["ok"] / wall, 2) if wall else None,
         "batch": batch, "wall_s": round(wall, 3),
@@ -276,7 +326,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         description="Load generator / client for the scoring service")
     parser.add_argument("--url", required=True,
-                        help="service base URL (http://host:port)")
+                        help="service base URL (http://host:port); "
+                             "comma-separate several for failover")
     parser.add_argument("--rps", type=float, default=20.0,
                         help="offered request rate (open loop)")
     parser.add_argument("--duration", type=float, default=5.0,

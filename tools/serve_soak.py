@@ -31,6 +31,26 @@ Fault cycles (``--schedule``):
 * ``refresh`` — a TORN newest checkpoint step is refresh-rejected
   (digest verification, old model keeps serving), then a good step is
   rolled across replicas one at a time under hammer load.
+* ``partition`` — replica 1's network partitions mid-dispatch (alive
+  process, connections torn with no response bytes) for
+  ``partition_seconds``; the fleet must QUARANTINE it (probation +
+  bounded re-probes), never respawn it, spend zero restart budget, and
+  un-quarantine on reconnect — all with zero client-visible failures.
+  The cycle also exercises the REMOTE replica backend: every replica is
+  placed through ``serve.remote_launch`` against ``serve.hosts``
+  (127.0.0.1, so the "remote" path runs end-to-end on one machine).
+* ``autoscale`` — replica 1 serves 400 ms slow, pushing the router tick
+  p95 past ``obs.slo_fleet_p95_ms``; the SLO-driven autoscaler must grow
+  the fleet within ``[min_replicas, max_replicas]`` under sustained
+  pressure, then shrink back on sustained idle — each decision an
+  evidence-carrying ``autoscale_event``. run_monitor exits 1 here BY
+  DESIGN: the injected pressure records real slo_violations.
+* ``canary`` — continuous deployment against a LIVE training run: a real
+  ``cli train`` subprocess writes checkpoints into the watched dir and
+  the fleet's refresh watcher rolls them canary-first. A deliberately
+  regressed step (slow only when the canary serves it) must be rolled
+  BACK at the canary stage with the prior model restored and serving
+  bit-identical scores; the good steps roll to the full fleet.
 * ``sigterm`` — the whole fleet is preempted after a clean load pass:
   admission stops, replicas drain, exit 75.
 * ``none``    — control cycle: load + clean shutdown, no fault.
@@ -63,15 +83,54 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 #: fault name -> DDT_FAULT_PLAN payload for the fleet's children. Replica 1
 #: is targeted (rank == fleet index via DDT_SERVE_REPLICA) so replica 0
 #: survives to carry the load while the fault plays out.
+#: The deliberately-regressed checkpoint step the canary cycle fabricates —
+#: pinned here so the fault plan (slow only when the canary SERVES this
+#: step) and the checkpoint writer agree.
+REGRESSED_STEP = 999
+
 FAULTS = {
     "none": None,
     "kill": {"rank": 1, "kill_replica_after_requests": 4},
     "wedge": {"rank": 1, "wedge_dispatcher_after": 3, "hang_seconds": 600.0},
     "refresh": None,
+    "partition": {"rank": 1, "partition_replica_after": 3,
+                  "partition_seconds": 4.0},
+    "autoscale": {"rank": 1, "slow_replica_ms": 400.0},
+    "canary": {"rank": 0, "slow_replica_ms": 600.0,
+               "slow_if_step": REGRESSED_STEP},
     "sigterm": None,
 }
 
-SCHEDULE = "kill,wedge,refresh,sigterm"
+SCHEDULE = "kill,wedge,refresh,partition,autoscale,canary,sigterm"
+
+#: run_monitor --once exits each cycle is ALLOWED to end with. The
+#: autoscale cycle records real slo_violations (that is the injected
+#: pressure working) so exit 1 is the expectation, not a failure; the
+#: canary cycle's regressed window may or may not cross a stats tick.
+MONITOR_OK = {"autoscale": (1,), "canary": (0, 1)}
+
+
+def _fault_overrides(fault: str, cycle_dir: str) -> list[str]:
+    """Per-fault config appended AFTER the base overrides (later wins)."""
+    if fault == "partition":
+        # Fast partition detection + probation cadence, and the remote
+        # replica backend end-to-end: every replica placed via the
+        # remote_launch template against a "host" that is this machine.
+        return ["serve.partition_after_misses=2",
+                "serve.probe_backoff_s=0.25", "serve.probe_backoff_max_s=1.0",
+                "serve.hosts=[127.0.0.1]",
+                "serve.remote_launch='/usr/bin/env DDT_REMOTE_HOST={host}'"]
+    if fault == "autoscale":
+        return ["serve.min_replicas=2", "serve.max_replicas=3",
+                "serve.scale_up_after=2", "serve.scale_down_after=3",
+                "serve.scale_cooldown_s=3", "serve.stats_every_s=1",
+                "obs.slo_fleet_p95_ms=150"]
+    if fault == "canary":
+        watch = os.path.join(cycle_dir, "live_ckpt")
+        return [f"serve.refresh_from={watch}", "serve.refresh_poll_s=0.5",
+                "serve.canary_requests=4", "serve.canary_timeout_s=10",
+                "obs.slo_fleet_p95_ms=150"]
+    return []
 
 
 def _stream_recs(path: str) -> list[dict]:
@@ -104,6 +163,46 @@ def _make_refresh_ckpt(cfg, directory: str) -> None:
                                      steps_per_epoch=4))
     mngr.close()
     truncate_checkpoint(directory, 20)
+
+
+def _make_regressed_ckpt(cfg, directory: str) -> None:
+    """A digest-VALID checkpoint at ``REGRESSED_STEP`` (fresh random
+    weights — a genuinely different, worse model) dropped into the canary
+    cycle's watched dir. The fault plan makes the canary replica slow only
+    while SERVING this step, so the canary window regresses and the roll
+    must come back."""
+    import jax
+
+    from data_diet_distributed_tpu.checkpoint import CheckpointManager
+    from data_diet_distributed_tpu.train.state import create_train_state
+    mngr = CheckpointManager(directory)
+    mngr.save(REGRESSED_STEP, create_train_state(cfg, jax.random.key(7),
+                                                 steps_per_epoch=4))
+    mngr.close()
+
+
+def _launch_train(args, cycle_dir: str, watch_dir: str,
+                  env: dict) -> subprocess.Popen:
+    """The LIVE training run whose promotion stream the canary cycle's
+    fleet follows: a real ``cli train`` writing epoch checkpoints into the
+    watched dir, with its own metrics/heartbeat artifacts so the fleet's
+    stream stays single-writer."""
+    train_env = {k: v for k, v in env.items() if k != "DDT_FAULT_PLAN"}
+    overrides = [
+        "data.dataset=synthetic", f"data.synthetic_size={args.size}",
+        "data.batch_size=64", f"model.arch={args.arch}",
+        "train.half_precision=false", "score.pretrain_epochs=0",
+        "score.batch_size=64", f"score.method={args.method}",
+        "train.num_epochs=2", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={watch_dir}",
+        f"obs.metrics_path={os.path.join(cycle_dir, 'train_metrics.jsonl')}",
+        f"obs.heartbeat_dir={os.path.join(cycle_dir, 'train_hb')}",
+    ]
+    return subprocess.Popen(
+        [sys.executable, "-m", "data_diet_distributed_tpu.cli", "train",
+         *overrides],
+        env=train_env, cwd=cycle_dir, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
 
 
 def _cycle_overrides(args, cycle_dir: str, refresh_dir: str) -> list[str]:
@@ -176,6 +275,63 @@ def _forensics(fault: str, recs: list[dict], rc: int,
                             f"{refresh_verdicts.get('roll')}")
         if refresh_verdicts.get("min_available", 0) < 1:
             problems.append("refresh: capacity hit zero during the roll")
+    elif fault == "partition":
+        # A partition is NOT a death: the supervisor must quarantine +
+        # probe + reconnect, never respawn, and spend zero restart budget.
+        for want in ("partitioned", "probation_probe", "reconnected"):
+            if want not in events:
+                problems.append(f"partition: no replica_event {want} record")
+        for never in ("respawn", "died"):
+            if never in events:
+                problems.append(f"partition: saw replica_event {never} — "
+                                "partition was mistaken for a death")
+        recon = [r for r in rep if r.get("event") == "reconnected"]
+        budget = refresh_verdicts.get("max_restarts")
+        if recon and recon[-1].get("restarts_left") != budget:
+            problems.append(
+                f"partition: restart budget was spent "
+                f"({recon[-1].get('restarts_left')} left of {budget})")
+    elif fault == "autoscale":
+        asc = [r for r in recs if r.get("kind") == "autoscale_event"]
+        ups = [r for r in asc if r.get("action") == "scale_up"]
+        downs = [r for r in asc if r.get("action") == "scale_down"]
+        if not ups:
+            problems.append("autoscale: no scale_up decision")
+        else:
+            up = ups[0]
+            if not up.get("reasons") or not (up.get("evidence") or
+                                             {}).get("p95_ms"):
+                problems.append("autoscale: scale_up names no evidence")
+            if up.get("replicas_to", 99) > (up.get("max_replicas") or 0):
+                problems.append("autoscale: grew past max_replicas")
+        if not downs:
+            problems.append("autoscale: no scale_down decision")
+        elif downs[-1].get("replicas_to", -1) < (downs[-1].get(
+                "min_replicas") or 0):
+            problems.append("autoscale: shrank below min_replicas")
+        if not any(r.get("event") == "spawn"
+                   and r.get("cause") == "autoscale" for r in rep):
+            problems.append("autoscale: no autoscale-caused spawn record")
+        if not any(r.get("event") == "retired"
+                   and r.get("cause") == "autoscale" for r in rep):
+            problems.append("autoscale: no autoscale-caused retire record")
+    elif fault == "canary":
+        if not any(r.get("status") == "roll_complete" for r in refresh):
+            problems.append("canary: live run's step never rolled")
+        rolled_back = [r for r in refresh
+                       if r.get("status") == "rolled_back"]
+        if not rolled_back:
+            problems.append("canary: regressed step was never rolled back")
+        elif not (rolled_back[-1].get("canary") or {}).get("reasons"):
+            problems.append("canary: rollback record carries no canary "
+                            "evidence")
+        if any(r.get("status") == "roll_complete"
+               and r.get("step") == refresh_verdicts.get("regressed_step")
+               for r in refresh):
+            problems.append("canary: the regressed step reached the fleet")
+        if not refresh_verdicts.get("bit_identical"):
+            problems.append("canary: post-rollback scores differ from the "
+                            "pre-regression baseline")
     if fault == "sigterm" or rc is not None:
         # Every cycle ends in SIGTERM; the preemption contract always holds.
         if rc != 75:
@@ -187,13 +343,14 @@ def _forensics(fault: str, recs: list[dict], rc: int,
 
 
 def run_cycle(args, index: int, fault: str, refresh_dir: str,
-              workdir: str) -> dict:
+              workdir: str, cfg) -> dict:
     import serve_client as sc
     from validate_metrics import validate_file
 
     cycle_dir = os.path.join(workdir, f"cycle{index}_{fault}")
     os.makedirs(cycle_dir, exist_ok=True)
     metrics = os.path.join(cycle_dir, "metrics.jsonl")
+    watch_dir = os.path.join(cycle_dir, "live_ckpt")
     env = {k: v for k, v in os.environ.items()
            if k not in ("DDT_FAULT_PLAN", "DDT_SERVE_REPLICA")}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -203,12 +360,15 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
     t0 = time.perf_counter()
     proc = subprocess.Popen(
         [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
-         *_cycle_overrides(args, cycle_dir, refresh_dir)],
+         *_cycle_overrides(args, cycle_dir, refresh_dir),
+         *_fault_overrides(fault, cycle_dir)],
         env=env, cwd=cycle_dir, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
     verdict = {"cycle": index, "fault": fault}
-    refresh_verdicts = {"replicas": args.replicas}
+    refresh_verdicts = {"replicas": args.replicas,
+                        "max_restarts": args.max_restarts}
     rc = None
+    train_proc = None
     try:
         port = None
         deadline = time.monotonic() + args.boot_timeout
@@ -244,6 +404,42 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
                 time.sleep(0.25)
             raise RuntimeError(f"never reached {n} available: {seen}")
 
+        def wait_for_record(pred, what, budget_s):
+            stop_at = time.monotonic() + budget_s
+            while time.monotonic() < stop_at:
+                if proc.poll() is not None:
+                    raise RuntimeError("fleet died mid-cycle: "
+                                       + proc.stdout.read()[-2000:])
+                hits = [r for r in _stream_recs(metrics) if pred(r)]
+                if hits:
+                    return hits[-1]
+                time.sleep(0.5)
+            raise RuntimeError(f"never saw {what} in the stream")
+
+        burst_loads: list[dict] = []
+        verdict["burst_loads"] = burst_loads
+
+        def burst_until(pred, what, budget_s):
+            """Short load bursts until the stream shows ``pred`` — the
+            canary hold judges ROUTED traffic, so the wait must drive
+            some."""
+            stop_at = time.monotonic() + budget_s
+            while time.monotonic() < stop_at:
+                if proc.poll() is not None:
+                    raise RuntimeError("fleet died mid-cycle: "
+                                       + proc.stdout.read()[-2000:])
+                hits = [r for r in _stream_recs(metrics) if pred(r)]
+                if hits:
+                    return hits[-1]
+                burst_loads.append(sc.load_generate(
+                    url, rps=args.rps, duration_s=2.0, batch=8,
+                    max_index=args.size - 1, timeout_s=120, retries=6,
+                    backoff_s=0.25))
+            raise RuntimeError(f"never saw {what} under load")
+
+        if fault == "canary":
+            # The live training run this fleet's refresh watcher follows.
+            train_proc = _launch_train(args, cycle_dir, watch_dir, env)
         wait_available(args.replicas, args.boot_timeout)
         # Open-loop load through the router — the fault (if any) fires
         # under it, and the bar is zero client-visible failures.
@@ -251,8 +447,55 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
             url, rps=args.rps, duration_s=args.duration, batch=8,
             max_index=args.size - 1, timeout_s=120, retries=6,
             backoff_s=0.25)
-        if fault in ("kill", "wedge"):
+        if fault in ("kill", "wedge", "partition"):
+            # kill/wedge: the casualty must respawn. partition: the
+            # quarantined replica must RECONNECT (no respawn — the
+            # forensics hold the budget to account).
             wait_available(args.replicas, args.respawn_timeout)
+        elif fault == "autoscale":
+            # The slow replica's sustained pressure fires the scale-up
+            # under the load window; the post-load idle (once the grown
+            # replica is routable — the N-1 discipline defers the drain
+            # until then) fires the scale-down.
+            wait_for_record(
+                lambda r: (r.get("kind") == "autoscale_event"
+                           and r.get("action") == "scale_up"),
+                "autoscale_event scale_up", 60)
+            wait_for_record(
+                lambda r: (r.get("kind") == "autoscale_event"
+                           and r.get("action") == "scale_down"),
+                "autoscale_event scale_down", args.respawn_timeout)
+            wait_available(args.replicas, args.respawn_timeout)
+        elif fault == "canary":
+            t_rc = train_proc.wait(timeout=600)
+            if t_rc != 0:
+                raise RuntimeError("live training run failed: "
+                                   + train_proc.stdout.read()[-2000:])
+            from data_diet_distributed_tpu.serve.fleet import discover_steps
+            final_step = max(discover_steps(watch_dir))
+            refresh_verdicts["live_final_step"] = final_step
+            # The run's newest promoted step rolls to the FULL fleet (the
+            # good model is fast, so its canary window passes).
+            burst_until(
+                lambda r: (r.get("kind") == "model_refresh"
+                           and r.get("status") == "roll_complete"
+                           and r.get("step") == final_step),
+                f"roll_complete of live step {final_step}", 120)
+            baseline = client.score(indices=list(range(16)))["scores"]
+            # The regressed model: digest-valid, genuinely different
+            # weights, slow only when the canary SERVES it. It must die at
+            # the canary stage, under live traffic.
+            refresh_verdicts["regressed_step"] = REGRESSED_STEP
+            _make_regressed_ckpt(cfg, watch_dir)
+            rb = burst_until(
+                lambda r: (r.get("kind") == "model_refresh"
+                           and r.get("status") == "rolled_back"),
+                "rolled_back", 120)
+            refresh_verdicts["rollback_record"] = {
+                "step": rb.get("step"), "canary": rb.get("canary"),
+                "prior": rb.get("prior")}
+            after = client.score(indices=list(range(16)))["scores"]
+            refresh_verdicts["bit_identical"] = after == baseline
         elif fault == "refresh":
             # Torn step 20 is the newest — a stepless refresh must be
             # rejected digest-loudly while the old model keeps serving.
@@ -301,6 +544,9 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+        if train_proc is not None and train_proc.poll() is None:
+            train_proc.kill()
+            train_proc.wait(timeout=30)
         if rc is None:
             rc = proc.returncode
     recs = _stream_recs(metrics)
@@ -311,7 +557,8 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
     except OSError as err:
         stream_problems = [f"{metrics}: unreadable ({err})"]
     problems = list(verdict.get("error") and [verdict["error"]] or [])
-    loads = [verdict.get("load") or {}, verdict.get("roll_load") or {}]
+    loads = [verdict.get("load") or {}, verdict.get("roll_load") or {},
+             *(verdict.get("burst_loads") or [])]
     sent = sum(ld.get("sent", 0) for ld in loads)
     errors = sum(ld.get("errors", 0) for ld in loads)
     rejected = sum(ld.get("rejected", 0) for ld in loads)
@@ -320,8 +567,9 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
     if errors or rejected:
         problems.append(f"client-visible failures: {errors} errors, "
                         f"{rejected} rejected of {sent}")
-    if monitor_exit != 0:
-        problems.append(f"run_monitor --once exit {monitor_exit}")
+    if monitor_exit not in MONITOR_OK.get(fault, (0,)):
+        problems.append(f"run_monitor --once exit {monitor_exit}, want one "
+                        f"of {MONITOR_OK.get(fault, (0,))}")
     problems += [f"stream: {p}" for p in stream_problems[:5]]
     problems += _forensics(fault, recs, rc, refresh_verdicts)
     verdict.update(
@@ -335,6 +583,7 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
     # soak_report needs.
     verdict.pop("load", None)
     verdict.pop("roll_load", None)
+    verdict.pop("burst_loads", None)
     return verdict
 
 
@@ -392,7 +641,7 @@ def main() -> int:
     t0 = time.perf_counter()
     cycles = []
     for i, fault in enumerate(schedule):
-        verdict = run_cycle(args, i, fault, refresh_dir, args.workdir)
+        verdict = run_cycle(args, i, fault, refresh_dir, args.workdir, cfg)
         cycles.append(verdict)
         driver_log.log("elastic_event", event="soak_cycle", **verdict)
     ok = bool(cycles) and all(c["ok"] for c in cycles)

@@ -71,8 +71,10 @@ KNOWN_KINDS = frozenset({
     # Serving fleet (serve/fleet.py + serve/router.py): fleet lifecycle
     # (supervise/launch/stats/drain/give_up/complete), per-replica
     # deaths/wedges/respawns + router breaker transitions, and model
-    # refresh installs/rejections/rolls.
-    "serve_fleet", "replica_event", "model_refresh",
+    # refresh installs/rejections/rolls. autoscale_event records every
+    # SLO-driven fleet-size decision (scale_up/scale_down/at_max) with
+    # the evidence that forced it.
+    "serve_fleet", "replica_event", "model_refresh", "autoscale_event",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -137,6 +139,10 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "serve_fleet": ("event",),
     "replica_event": ("replica", "event"),
     "model_refresh": ("tenant", "status"),
+    # Autoscaler decisions. Null-tolerant like elastic_event: evidence
+    # values (tick p95, queue depth) may be null on a traffic-free tick —
+    # the action and the before/after sizes are universal.
+    "autoscale_event": ("action", "replicas_from", "replicas_to"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
