@@ -175,7 +175,9 @@ def _append_ledger(line: dict) -> None:
                "source": "bench", "geometry": _LEDGER["geometry"]}
         for k in ("metric", "value", "unit", "vs_baseline", "error",
                   "exit_class", "chunk_steps", "mfu", "pass_s",
-                  "score_stability", "slo", "serve", "comm", "run_id"):
+                  "score_stability", "slo", "serve", "comm", "run_id",
+                  "data_plane", "prefetch_depth", "stall_frac", "overlap",
+                  "stall_s"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -289,6 +291,20 @@ def main() -> None:
                              "batches per dispatch through the chunked score "
                              "engine). Default auto; 0/1 forces "
                              "per-step/per-batch")
+    parser.add_argument("--data-plane", default="auto",
+                        choices=["auto", "resident", "streaming"],
+                        help="score task feed engine A/B: resident = blocks "
+                             "uploaded once (ScoreResident, the default when "
+                             "the dataset fits HBM); streaming = blocks "
+                             "assembled on the prefetch thread and uploaded "
+                             "just-in-time (ScoreStream) — the lane reports "
+                             "stall_frac + achieved overlap next to "
+                             "throughput. auto keeps score_dataset's "
+                             "size-based rule")
+    parser.add_argument("--prefetch-depth", type=int, default=2,
+                        help="streaming lane: blocks the assembler runs ahead "
+                             "of dispatch (0 = synchronous, the overlap A/B "
+                             "baseline)")
     parser.add_argument("--grand-chunk", type=int, default=64,
                         help="vmap(grad) chunk per device for the grand_vmap "
                              "method (was --chunk's meaning before the "
@@ -383,6 +399,13 @@ def main() -> None:
                            "batch": args.batch, "method": args.method,
                            "mesh": args.mesh,
                            "num_processes": args.num_processes}
+    # Explicit --data-plane lanes are their OWN comparison groups (depth too:
+    # the d0/d2 A/B measures different machines). auto keeps the historical
+    # geometry shape so every pre-lane ledger baseline stays comparable.
+    if args.data_plane != "auto":
+        _LEDGER["geometry"]["data_plane"] = args.data_plane
+        if args.data_plane == "streaming":
+            _LEDGER["geometry"]["prefetch_depth"] = args.prefetch_depth
 
     if args.num_processes > 1:
         # Multi-process rendezvous must happen before any backend init, so the
@@ -575,10 +598,46 @@ def bench_score(args, metric: str) -> None:
     # (the same HBM budget score_dataset gates on); --chunk 0 forces the
     # per-batch engine (the A/B the PERFORMANCE.md table records).
     from data_diet_distributed_tpu.ops.scoring import fits_residency
+    streaming = args.data_plane == "streaming" and args.num_processes == 1
     k_chunk = resolve_score_chunk_steps(
-        args.chunk, nb, args.num_processes == 1
-        and fits_residency(train_ds, n_devices))
-    if k_chunk > 1:
+        args.chunk, nb, streaming or (
+            args.num_processes == 1
+            and (args.data_plane == "resident"
+                 or fits_residency(train_ds, n_devices))))
+    stream = None
+    if k_chunk > 1 and streaming:
+        # Streaming lane: every pass re-assembles + re-uploads its blocks on
+        # the prefetch thread while the previous block's dispatch runs — THE
+        # host-lane A/B vs the upload-once resident arm below. Stall
+        # accounting (warmup excluded) rides the emitted line.
+        from data_diet_distributed_tpu.ops.scores import make_score_chunk
+        from data_diet_distributed_tpu.ops.scoring import ScoreStream
+        stream = ScoreStream(train_ds, batch_size,
+                             mesh if mesh.size > 1 else None,
+                             prefetch_depth=args.prefetch_depth)
+        chunk_fn = make_score_chunk(
+            model, args.method, mesh if mesh.size > 1 else None,
+            chunk=args.grand_chunk,
+            use_pallas=False if args.no_pallas else None)
+        dispatches = -(-nb // k_chunk)
+
+        @jax.jit
+        def _block_checksum(out):
+            return jnp.sum(out.astype(jnp.float32))
+
+        def run_pass():
+            # Per-block scalar fetch, NOT dispatch-all-then-fetch: the
+            # streaming plane's contract is bounded in-flight memory, so the
+            # lane holds at most ~(prefetch_depth + 1) blocks live — and the
+            # per-block barrier is what the prefetch thread overlaps
+            # (depth 0 assembles inside the barrier gap; that delta is the
+            # stall_frac A/B this lane exists to measure).
+            total = 0.0
+            for blk in stream.blocks(k_chunk):
+                total += float(jax.device_get(
+                    _block_checksum(chunk_fn(variables, *blk))))
+            return total
+    elif k_chunk > 1:
         from data_diet_distributed_tpu.ops.scores import make_score_chunk
         resident = ScoreResident(train_ds, batch_size,
                                  mesh if mesh.size > 1 else None)
@@ -626,6 +685,8 @@ def bench_score(args, metric: str) -> None:
     from data_diet_distributed_tpu.obs import StepTimer
 
     run_pass()  # warmup: compile + one full pass
+    if stream is not None:
+        stream.stall_stats.clear()   # warmup stalls are compile, not overlap
     timer = StepTimer(warmup=0)   # warmup pass already excluded above
     t0 = time.perf_counter()
     for _ in range(args.repeats):
@@ -648,6 +709,19 @@ def bench_score(args, metric: str) -> None:
     mean_pass = wall / max(args.repeats, 1)
     extra.update(chunk_steps=k_chunk, dispatches_per_epoch=dispatches,
                  dispatches_per_sec=round(dispatches / mean_pass, 2))
+    if args.data_plane != "auto":
+        extra["data_plane"] = args.data_plane
+    if stream is not None:
+        # Streaming-lane overlap verdict: stall_frac = fraction of the timed
+        # wall the consumer waited on the assembler; overlap = the rest —
+        # assembly + upload hidden behind dispatch. Measured, not asserted.
+        stall_frac = float(stream.stall_stats.get("stall_frac", 0.0))
+        extra.update(data_plane="streaming",
+                     prefetch_depth=args.prefetch_depth,
+                     stall_frac=round(stall_frac, 4),
+                     overlap=round(1.0 - stall_frac, 4),
+                     stall_s=round(float(
+                         stream.stall_stats.get("stall_s", 0.0)), 4))
     extra.update(_xla_extras("score_chunk", examples_per_sec))
     extra.update(_score_quality_block(args, model, train_ds, mesh, sharder,
                                       batch_size))
@@ -685,6 +759,8 @@ def _score_quality_block(args, model, train_ds, mesh, sharder,
                           batch_size=batch_size, sharder=sharder,
                           chunk=args.grand_chunk, chunk_steps=args.chunk,
                           use_pallas=False if args.no_pallas else None,
+                          data_plane=args.data_plane,
+                          prefetch_depth=args.prefetch_depth,
                           seed_ids=seeds)
         finally:
             if prev is not None:

@@ -47,12 +47,28 @@ class DataConfig:
     # datasets (digits/characters via the npz path) where mirroring changes
     # example semantics.
     flip: bool = True
+    # --- streaming data plane (data/sharded.py + data/pipeline.py) ----------
+    # auto | streaming | resident. "auto" keeps the residency heuristics
+    # (resident engines when the dataset fits, per-step streaming otherwise);
+    # "streaming" forces the streaming plane — prefetched chunk blocks /
+    # per-step prefetch, nothing dataset-sized held in HBM (bit-identical to
+    # resident, pinned); "resident" requires residency and errors where it
+    # cannot be honored (multi-host, oversized datasets).
+    data_plane: str = "auto"
+    # Host→device prefetch depth for the streaming plane: the background
+    # assembler keeps up to this many blocks/batches decoded, normalized, and
+    # uploaded ahead of the dispatch loop. 0 = synchronous assembly (the A/B
+    # baseline bench.py --data-plane measures against).
+    prefetch_depth: int = 2
+    # Decoded-shard LRU budget for dataset="sharded" (bytes): a hard host-RAM
+    # bound — exceeding it evicts the coldest decoded shard, never OOMs.
+    host_cache_bytes: int = 1 << 30
 
     @property
     def num_classes(self) -> int | None:
         """Class count when statically known; None for npz (inferred at load)."""
         return {"cifar10": 10, "cifar100": 100, "synthetic": 10,
-                "synthetic_imagenet": 100, "npz": None}[self.dataset]
+                "synthetic_imagenet": 100, "npz": None, "sharded": None}[self.dataset]
 
 
 @dataclass
@@ -663,8 +679,20 @@ class Config:
 
     def validate(self) -> "Config":
         if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
-                                     "synthetic_imagenet", "npz"):
+                                     "synthetic_imagenet", "npz", "sharded"):
             raise ValueError(f"unknown dataset {self.data.dataset!r}")
+        if self.data.data_plane not in ("auto", "streaming", "resident"):
+            raise ValueError(
+                f"data.data_plane must be auto | streaming | resident, got "
+                f"{self.data.data_plane!r}")
+        if self.data.prefetch_depth < 0:
+            raise ValueError(
+                f"data.prefetch_depth must be >= 0 (0 = synchronous), got "
+                f"{self.data.prefetch_depth}")
+        if self.data.host_cache_bytes <= 0:
+            raise ValueError(
+                f"data.host_cache_bytes must be > 0, got "
+                f"{self.data.host_cache_bytes}")
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
         for s in self.prune.sweep:

@@ -114,6 +114,16 @@ class BatchAssembler:
             mean, std = norm
             rows_padded = _pad_rows(take, batch_size)
             if images.dtype == np.uint8:
+                if not isinstance(images, np.ndarray):
+                    # Virtual arrays (ShardedImages): gather the batch's rows
+                    # through the bounded shard cache FIRST — handing the
+                    # whole object to the native kernel would materialize it
+                    # (``np.ascontiguousarray``) — then normalize the gathered
+                    # uint8 rows with the SAME kernel (identity take), so the
+                    # sharded plane is bit-identical to the npz/mmap path.
+                    images = np.ascontiguousarray(images[rows_padded])
+                    take = np.arange(batch_size, dtype=np.int64)
+                    rows_padded = take
                 image = gather_normalize_u8(
                     images, np.ascontiguousarray(take, np.int64), mean, std,
                     batch_size)
@@ -127,7 +137,8 @@ class BatchAssembler:
                 f"lazy normalization expects uint8/float32 images, "
                 f"got {images.dtype}")
         row_shape = images.shape[1:]
-        if lib is not None and images.dtype == np.float32:
+        if (lib is not None and images.dtype == np.float32
+                and isinstance(images, np.ndarray)):
             if (not self.reuse or self._img_buf is None
                     or self._img_buf.shape != (batch_size, *row_shape)):
                 self._img_buf = np.empty((batch_size, *row_shape), np.float32)

@@ -17,12 +17,18 @@ Replaces the reference's DataLoader + DistributedSampler stack (``data/loader.py
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
 from collections.abc import Iterator
+from typing import NamedTuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import registry as obs_registry
+from ..obs import tracing
 from .datasets import ArrayDataset
 
 Batch = dict[str, np.ndarray]
@@ -180,6 +186,193 @@ def device_stream(ds: ArrayDataset, batch_size: int, sharder: BatchSharder, *,
         yield hb, sharder(hb, images_local=image_slice is not None)
 
 
+class PrefetchIterator:
+    """Double-buffered host→device prefetch: run a producer iterator in a
+    background assembler thread, buffering up to ``depth`` finished items
+    (``data.prefetch_depth``, default 2) so the consumer's dispatch loop never
+    waits on host-side assembly while the device is busy.
+
+    Resilience contract: the consumer blocks in BOUNDED ``queue.get`` polls,
+    so the main thread keeps reaching bytecode boundaries — a wedged assembler
+    thread means no new items, no watchdog beats from the dispatch loop, and
+    the watchdog fires (a retriable ``WatchdogTimeout``), never a silent hang.
+    ``close()`` (or exhausting the iterator) drains the thread promptly — the
+    SIGTERM/chunk-boundary checkpoint path wraps epochs in
+    ``contextlib.closing`` so a ``Preempted`` raise stops assembly cleanly.
+    Producer exceptions re-raise in the consumer at the point of consumption.
+
+    Stall accounting: every post-warmup wait is the host-wait inside the
+    dispatch loop — summed into ``stall_s``, observed on the per-stage
+    ``prefetch_stall_s:<stage>`` histogram, and traced as ``cat="prefetch"``
+    spans (``trace_report`` summarizes stall p50/p95 per stage). The first
+    wait is pipeline warmup (thread start + first assembly), reported
+    separately — steady-state ``stall_frac = stall_s / elapsed_s`` is the A/B
+    number ``bench --data-plane`` ledgers.
+
+    ``depth <= 0`` is the SYNCHRONOUS mode: no thread, the consumer runs the
+    producer inline — the A/B baseline, with the same stall accounting (every
+    post-warmup assembly wall is a stall by definition)."""
+
+    _SENTINEL = object()
+    _POLL_S = 0.5
+
+    def __init__(self, producer, depth: int = 2, stage: str = "stream"):
+        self.stage = stage
+        self.depth = max(0, int(depth))
+        self.stall_s = 0.0
+        self.warmup_s = 0.0
+        self.items = 0
+        self._stop = threading.Event()
+        self._exc: BaseException | None = None
+        self._exhausted = False
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+        self._thread: threading.Thread | None = None
+        if self.depth > 0:
+            self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._run, args=(producer,),
+                name=f"prefetch:{stage}", daemon=True)
+            self._thread.start()
+        else:
+            self._producer = iter(producer)
+
+    def _run(self, producer) -> None:
+        try:
+            for item in producer:
+                if not self._put(item):
+                    return   # closed mid-epoch: drop the in-flight item
+        except BaseException as e:   # noqa: BLE001 — re-raised in consumer
+            self._exc = e
+        finally:
+            self._put(self._SENTINEL)
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=self._POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        t0 = time.perf_counter()
+        if self._thread is None:          # synchronous baseline
+            try:
+                item = next(self._producer)
+            except StopIteration:
+                self._exhausted = True
+                raise
+        else:
+            while True:
+                try:
+                    item = self._q.get(timeout=self._POLL_S)
+                    break
+                except queue.Empty:
+                    # bounded poll: watchdog/KeyboardInterrupt can land
+                    continue
+        now = time.perf_counter()
+        if item is self._SENTINEL:
+            self._exhausted = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        if self.items == 0:
+            self.warmup_s = now - t0
+            self._t_first = now
+        else:
+            wait = now - t0
+            self.stall_s += wait
+            obs_registry.observe(f"prefetch_stall_s:{self.stage}", wait)
+            if wait > 1e-4:
+                tracing.complete("prefetch_stall", t0, cat="prefetch",
+                                 stage=self.stage)
+        self.items += 1
+        self._t_last = now
+        return item
+
+    def close(self) -> None:
+        """Stop the assembler and drain the queue (idempotent)."""
+        self._stop.set()
+        if self._thread is None:
+            return
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        elapsed = ((self._t_last - self._t_first)
+                   if self._t_first is not None and self.items > 1 else 0.0)
+        return {"stage": self.stage, "prefetch_depth": self.depth,
+                "items": self.items, "stall_s": self.stall_s,
+                "warmup_s": self.warmup_s, "elapsed_s": elapsed,
+                "stall_frac": (self.stall_s / elapsed if elapsed > 0
+                               else 0.0)}
+
+
+def prefetch_stream(ds: ArrayDataset, batch_size: int, sharder: BatchSharder,
+                    *, shuffle: bool = False, seed: int = 0, epoch: int = 0,
+                    depth: int = 2, assembler: "BatchAssembler | None" = None,
+                    stage: str = "train"):
+    """``device_stream`` with assembly AND device placement running ``depth``
+    batches ahead in a background thread (yields the same
+    ``(host_batch, device_batch)`` pairs). ``depth <= 0`` assembles inline on
+    the consumer thread — the A/B baseline — with the same stall accounting."""
+    it = device_stream(ds, batch_size, sharder, shuffle=shuffle, seed=seed,
+                       epoch=epoch, assembler=assembler)
+    return PrefetchIterator(it, depth=depth, stage=stage)
+
+
+def host_cache_of(ds: ArrayDataset):
+    """The dataset's bounded decoded-shard cache (``data/sharded.ShardCache``)
+    when its images are shard-backed; None for in-RAM/mmap datasets."""
+    return getattr(getattr(ds, "images", None), "cache", None)
+
+
+def data_plane_record(stage: str, engine: str, stats: dict | None,
+                      ds: ArrayDataset | None = None) -> dict:
+    """The ``{"kind": "data_plane"}`` payload + its registry gauges — ONE
+    shape for every stage (fit tags, score passes, bench lanes) so stream
+    consumers and the KINDS lint see a single schema. ``stats`` is a
+    ``PrefetchIterator.stats()`` dict (or an accumulated total); None means
+    the stage ran without prefetch (resident or synchronous engine)."""
+    stats = stats or {}
+    cache = host_cache_of(ds) if ds is not None else None
+    in_use = cache.bytes_in_use if cache is not None else 0
+    depth = int(stats.get("prefetch_depth", 0))
+    stall_s = float(stats.get("stall_s", 0.0))
+    obs_registry.set_gauge("prefetch_depth", depth)
+    obs_registry.set_gauge("prefetch_stall_s", stall_s)
+    obs_registry.set_gauge("host_cache_bytes_in_use", in_use)
+    rec = {"stage": stage, "engine": engine, "prefetch_depth": depth,
+           "stall_s": round(stall_s, 6),
+           "stall_frac": round(float(stats.get("stall_frac", 0.0)), 6),
+           "host_cache_bytes_in_use": int(in_use)}
+    if stats.get("items"):
+        rec["items"] = int(stats["items"])
+        rec["warmup_s"] = round(float(stats.get("warmup_s", 0.0)), 6)
+    if cache is not None:
+        rec["host_cache_evictions"] = cache.evictions
+        rec["host_cache_budget_bytes"] = cache.budget_bytes
+    return rec
+
+
 # Auto device-residency cap for ResidentBatches: the arrays are replicated per
 # device, so this bounds HBM per device (CIFAR at bf16 is ~0.3 GiB).
 RESIDENT_MAX_BYTES = 2 << 30
@@ -309,3 +502,137 @@ def maybe_resident(ds: ArrayDataset, mesh: Mesh, batch_size: int,
     if enabled is None and nbytes > RESIDENT_MAX_BYTES:
         return None
     return ResidentBatches(ds, mesh, batch_size, image_dtype)
+
+
+class ChunkBlock(NamedTuple):
+    """One prefetched block for the chunked engine, already on device:
+    ``chunk_fn(state, images, labels, indices, idx, mask)`` takes its fields
+    positionally. ``idx`` is the identity gather — composition happened on the
+    host, so the in-scan gather is a no-op reorder and the math is the
+    resident engine's, verbatim."""
+
+    images: jax.Array    # [K*B, ...] replicated, image_dtype
+    labels: jax.Array    # [K*B] int32 replicated (padded rows zeroed)
+    indices: jax.Array   # [K*B] int32 replicated (padded rows zeroed)
+    idx: jax.Array       # [K, B] int32 — arange(K*B): identity gather
+    mask: jax.Array      # [K, B] float32
+
+
+class StreamingBatches:
+    """Streaming twin of ``ResidentBatches`` for the chunked engine: nothing
+    is permanently device-resident — a background assembler gathers and
+    normalizes the next ``chunk_steps``-step block (through the bounded shard
+    cache for sharded datasets) and uploads it while the current chunk is in
+    flight, so ``make_train_chunk`` dispatches stay back-to-back.
+
+    Bit-identity contract: blocks are stacked straight from
+    ``iterate_batches`` output — the SAME epoch permutation, row-0 tail
+    padding, and zeroed padded labels/indices as every other engine — and fed
+    with an identity ``idx``, so the scan body sees exactly the batches the
+    resident gather produces (pinned in tier-1 against ``ResidentBatches``).
+
+    Device memory is bounded at ~``(prefetch_depth + 1)`` blocks: each
+    dispatch consumes its block's operand references, so finished blocks free
+    as the queue advances. Single-process only, like the chunked engine it
+    feeds (multi-host runs use the per-step path with per-rank image slices).
+    """
+
+    def __init__(self, ds: ArrayDataset, mesh: Mesh, batch_size: int,
+                 image_dtype=np.float32, *, prefetch_depth: int = 2,
+                 data_axis: str = "data"):
+        if jax.process_count() > 1:
+            raise ValueError(
+                "StreamingBatches is single-process only; multi-host runs "
+                "stream per-step with per-rank image slices")
+        self.ds = ds
+        self.n = len(ds)
+        self.batch_size = batch_size
+        self.image_dtype = image_dtype
+        self.prefetch_depth = prefetch_depth
+        self.out_sharding = NamedSharding(mesh, P(data_axis))
+        self._replicated = NamedSharding(mesh, P())
+
+    def _block(self, pend: list[Batch]) -> ChunkBlock:
+        import jax.numpy as jnp
+
+        k = len(pend)
+        b = self.batch_size
+        images = np.concatenate([np.asarray(hb["image"]) for hb in pend])
+        # Same elementwise cast as the resident upload (bf16 halves transfer).
+        images = np.asarray(images, dtype=jnp.dtype(self.image_dtype))
+        labels = np.ascontiguousarray(
+            np.concatenate([hb["label"] for hb in pend]), np.int32)
+        indices = np.ascontiguousarray(
+            np.concatenate([hb["index"] for hb in pend]), np.int32)
+        idx = np.arange(k * b, dtype=np.int32).reshape(k, b)
+        mask = np.ascontiguousarray(
+            np.stack([hb["mask"] for hb in pend]), np.float32)
+
+        def put(x):
+            return jax.device_put(x, self._replicated)
+
+        return ChunkBlock(put(images), put(labels), put(indices), put(idx),
+                          put(mask))
+
+    def chunk_blocks(self, chunk_steps: int, *, shuffle: bool = False,
+                     seed: int = 0, epoch: int = 0) -> PrefetchIterator:
+        """One epoch of ``ChunkBlock``s, assembled+uploaded ``prefetch_depth``
+        blocks ahead. The epoch tail is a shorter block (a second compiled
+        chunk length, same as ``chunk_indices`` — never a padded dispatch)."""
+        def produce():
+            pend: list[Batch] = []
+            for hb in iterate_batches(self.ds, self.batch_size,
+                                      shuffle=shuffle, seed=seed, epoch=epoch):
+                pend.append(hb)
+                if len(pend) == chunk_steps:
+                    yield self._block(pend)
+                    pend = []
+            if pend:
+                yield self._block(pend)
+
+        return PrefetchIterator(produce(), depth=self.prefetch_depth,
+                                stage="train")
+
+
+class EvalBatchCache:
+    """Cache the test set's DEVICE batches across epochs when the eval
+    geometry is unchanged — the ``device_stream`` path re-assembled and
+    re-uploaded the whole test set every eval (the re-upload noted in the
+    ``ResidentBatches`` docstring) even though neither the data nor the
+    placement changes between epochs. Bounded: datasets whose device copy
+    would exceed ``max_bytes`` stream fresh (they are exactly the datasets
+    the streaming plane exists for)."""
+
+    def __init__(self, max_bytes: int = RESIDENT_MAX_BYTES):
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self._key = None
+        self._batches: list | None = None
+
+    def stream(self, ds: ArrayDataset, batch_size: int,
+               sharder: BatchSharder):
+        key = (id(ds), len(ds), batch_size, sharder.sharding)
+        if self._batches is not None and self._key == key:
+            self.hits += 1
+            return iter(self._batches)
+        nbytes = int(np.prod(ds.images.shape)) * 4
+        if nbytes > self.max_bytes:
+            return (db for _, db in device_stream(ds, batch_size, sharder))
+        batches = [db for _, db in device_stream(ds, batch_size, sharder)]
+        self._key, self._batches = key, batches
+        return iter(batches)
+
+
+def merge_stall_stats(total: dict, stats: dict) -> dict:
+    """Fold one epoch's ``PrefetchIterator.stats()`` into a running total,
+    in place (same shape, so ``data_plane_record`` takes either)."""
+    if not total:
+        total.update(stats)
+        return total
+    total["items"] += stats.get("items", 0)
+    total["stall_s"] += stats.get("stall_s", 0.0)
+    total["warmup_s"] += stats.get("warmup_s", 0.0)
+    total["elapsed_s"] += stats.get("elapsed_s", 0.0)
+    total["stall_frac"] = (total["stall_s"] / total["elapsed_s"]
+                           if total["elapsed_s"] > 0 else 0.0)
+    return total

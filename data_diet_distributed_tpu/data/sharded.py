@@ -1,0 +1,342 @@
+"""On-disk sharded dataset format: npy shards + a digested JSON manifest.
+
+The resident engines (``ResidentBatches``, ``ScoreResident``) cap the framework
+at datasets that fit HBM, and the lazy ``.npy`` ingestion path still assumes one
+file per split that every host mmaps whole. This module is the scale-out format
+underneath the streaming data plane (``data/pipeline.py``): each split is a
+directory of fixed-size ``.npy`` image shards plus tiny global label arrays,
+described by ``manifest.json`` with per-shard row counts, dtypes, and sha256
+digests — the same digest discipline the checkpoint tier manifests use, so a
+torn shard is a loud verification error, never silent garbage scores.
+
+Ownership: under a multi-process runtime each rank *owns* ``shards[rank::world]``
+(``owned_shards``). Batch rows are contiguous per rank (``BatchSharder`` feeds
+process ``p`` rows ``[p*B/P, (p+1)*B/P)`` of every batch), so when the shard
+size equals the per-rank batch slice (``make_shards --shard-size``), an
+unshuffled pass has rank ``r`` reading exactly its owned shards — no rank ever
+reads another rank's bytes, matching the PR-10 streaming score fetch's
+``replica_id == 0`` row ownership, and the one-sliced-sum-per-seed join is
+unchanged. Labels/indices are global metadata (4 bytes/row) and are read by
+every rank, exactly like the global label/index/mask arrays in
+``iterate_batches(image_slice=...)``.
+
+Host RAM is bounded: decoded shards live in an LRU ``ShardCache`` capped at
+``data.host_cache_bytes``; exceeding the budget evicts the coldest shard —
+never OOMs. A gather groups its rows by shard and touches each needed shard
+once, so even a cache sized to ONE shard streams a full epoch without
+eviction thrash (each shard is loaded at most once per batch).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+MANIFEST_NAME = "manifest.json"
+FORMAT = "ddt-shards-v1"
+
+#: Default per-shard row count for the converter (v4-scale: 4096 rows of
+#: 96x96x3 uint8 is ~110 MiB decoded — a few shards fit any sane budget).
+DEFAULT_SHARD_SIZE = 4096
+
+#: Default decoded-shard LRU budget (``data.host_cache_bytes``).
+DEFAULT_HOST_CACHE_BYTES = 1 << 30
+
+
+def manifest_path(data_dir: str) -> str:
+    return os.path.join(data_dir, MANIFEST_NAME)
+
+
+def is_sharded_dir(data_dir: str) -> bool:
+    return os.path.exists(manifest_path(data_dir))
+
+
+def owned_shards(num_shards: int, rank: int, world: int) -> list[int]:
+    """The shard ids rank ``rank`` of ``world`` owns: ``shards[rank::world]``."""
+    return list(range(num_shards))[rank::world]
+
+
+def _sha256_file(path: str, chunk_bytes: int = 1 << 22) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk_bytes)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _save_atomic(path: str, array: np.ndarray) -> None:
+    """Write-then-rename so a killed converter never leaves a torn shard
+    under the final name (the manifest digests catch torn bytes anyway; this
+    keeps partial files from even looking like shards)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.save(fh, array)
+    os.replace(tmp, path)
+
+
+def write_split(out_dir: str, split: str, images, labels: np.ndarray,
+                shard_size: int = DEFAULT_SHARD_SIZE) -> dict:
+    """Write one split's shards + labels file; returns the split manifest dict.
+
+    ``images`` may be any row-sliceable array (ndarray or ``np.memmap``) —
+    each shard is materialized one slice at a time, so converting a dataset
+    never needs the whole decoded split in RAM.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(labels)
+    if len(images) != n:
+        raise ValueError(f"{split}: {len(images)} images vs {n} labels")
+    if shard_size <= 0:
+        raise ValueError(f"shard_size must be positive, got {shard_size}")
+    shards = []
+    for i, start in enumerate(range(0, n, shard_size)):
+        stop = min(start + shard_size, n)
+        fname = f"{split}-shard-{i:05d}.npy"
+        path = os.path.join(out_dir, fname)
+        _save_atomic(path, np.ascontiguousarray(images[start:stop]))
+        shards.append({"file": fname, "start": start, "count": stop - start,
+                       "sha256": _sha256_file(path)})
+    labels_file = f"{split}-labels.npy"
+    labels_path = os.path.join(out_dir, labels_file)
+    _save_atomic(labels_path, np.ascontiguousarray(labels, np.int32))
+    return {
+        "n": n,
+        "image_shape": [int(d) for d in np.shape(images)[1:]],
+        "image_dtype": str(np.asarray(images[:0]).dtype),
+        "label_dtype": "int32",
+        "shard_size": int(shard_size),
+        "shards": shards,
+        "labels": {"file": labels_file, "sha256": _sha256_file(labels_path)},
+    }
+
+
+def write_manifest(out_dir: str, splits: dict, num_classes: int,
+                   norm: tuple | None) -> str:
+    """Write ``manifest.json`` (atomically) tying the split dicts together.
+
+    ``norm=(mean, std)`` in [0,1] units for uint8 shards (lazy per-batch
+    normalization, the ``.npy`` ingestion convention); None for float32
+    shards already in model units."""
+    from ..utils.io import atomic_write_json
+    manifest = {
+        "format": FORMAT,
+        "num_classes": int(num_classes),
+        "norm": (None if norm is None else
+                 {"mean": [float(v) for v in np.asarray(norm[0]).ravel()],
+                  "std": [float(v) for v in np.asarray(norm[1]).ravel()]}),
+        "splits": splits,
+    }
+    path = manifest_path(out_dir)
+    atomic_write_json(path, manifest)
+    return path
+
+
+def read_manifest(data_dir: str) -> dict:
+    with open(manifest_path(data_dir)) as fh:
+        manifest = json.load(fh)
+    if manifest.get("format") != FORMAT:
+        raise ValueError(
+            f"{manifest_path(data_dir)}: unknown format "
+            f"{manifest.get('format')!r} (expected {FORMAT!r})")
+    return manifest
+
+
+def verify_manifest(data_dir: str) -> list[str]:
+    """Re-hash every file against the manifest; problems as strings (empty =
+    intact). The checkpoint-tier digest discipline applied to data: a torn or
+    bit-flipped shard is a LOUD error before it can feed garbage scores."""
+    problems: list[str] = []
+    try:
+        manifest = read_manifest(data_dir)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return [f"{manifest_path(data_dir)}: {e}"]
+    for split, meta in manifest.get("splits", {}).items():
+        expect_next = 0
+        for shard in meta.get("shards", ()):
+            path = os.path.join(data_dir, shard["file"])
+            if shard["start"] != expect_next:
+                problems.append(
+                    f"{split}: shard {shard['file']} starts at "
+                    f"{shard['start']}, expected {expect_next} (gap/overlap)")
+            expect_next = shard["start"] + shard["count"]
+            if not os.path.exists(path):
+                problems.append(f"{split}: missing shard file {shard['file']}")
+                continue
+            digest = _sha256_file(path)
+            if digest != shard["sha256"]:
+                problems.append(
+                    f"{split}: shard {shard['file']} digest mismatch "
+                    f"(manifest {shard['sha256'][:12]}…, file {digest[:12]}…)"
+                    " — torn or corrupted shard")
+        if expect_next != meta["n"]:
+            problems.append(
+                f"{split}: shards cover {expect_next} rows, manifest says "
+                f"n={meta['n']}")
+        labels = meta.get("labels")
+        if labels:
+            path = os.path.join(data_dir, labels["file"])
+            if not os.path.exists(path):
+                problems.append(f"{split}: missing labels file "
+                                f"{labels['file']}")
+            elif _sha256_file(path) != labels["sha256"]:
+                problems.append(
+                    f"{split}: labels file {labels['file']} digest mismatch")
+    return problems
+
+
+class ShardCache:
+    """LRU over decoded shards with a HARD byte budget — the
+    ``data.host_cache_bytes`` bound. ``get`` loads through ``loader`` on a
+    miss and evicts coldest-first until the budget holds again; the entry
+    just loaded is never evicted (a budget smaller than one shard degrades
+    to load-per-touch, it does not livelock or OOM)."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_HOST_CACHE_BYTES):
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"host cache budget must be positive, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.bytes_in_use = 0
+        self.loads = 0
+        self.hits = 0
+        self.evictions = 0
+        self._entries: OrderedDict[object, np.ndarray] = OrderedDict()
+
+    def get(self, key, loader) -> np.ndarray:
+        entry = self._entries.get(key)
+        if entry is not None:
+            self.hits += 1
+            self._entries.move_to_end(key)
+            return entry
+        entry = loader()
+        self.loads += 1
+        self._entries[key] = entry
+        self.bytes_in_use += entry.nbytes
+        while self.bytes_in_use > self.budget_bytes and len(self._entries) > 1:
+            _, evicted = self._entries.popitem(last=False)
+            self.bytes_in_use -= evicted.nbytes
+            self.evictions += 1
+        self._note_gauges()
+        return entry
+
+    def _note_gauges(self) -> None:
+        from ..obs import registry as obs_registry
+        obs_registry.set_gauge("host_cache_bytes_in_use", self.bytes_in_use)
+
+    def stats(self) -> dict:
+        return {"bytes_in_use": self.bytes_in_use,
+                "budget_bytes": self.budget_bytes, "loads": self.loads,
+                "hits": self.hits, "evictions": self.evictions}
+
+
+class ShardedImages:
+    """A virtual image array backed by on-disk shards through a bounded cache.
+
+    Quacks enough like the ``[N, H, W, C]`` ndarray every data-layer consumer
+    indexes (``shape``/``dtype``/``size``/``nbytes``/``len``/fancy
+    ``__getitem__``) that ``ArrayDataset`` carries it unchanged: batch
+    assembly gathers rows through the LRU shard cache, residency predicates
+    read the logical shape, and ``dense()``/``np.asarray`` materialize
+    explicitly via ``__array__``. A gather sorts its rows by shard id and
+    loads each needed shard once, so per-batch disk traffic is bounded by the
+    batch's shard span even when the cache holds a single shard."""
+
+    def __init__(self, data_dir: str, split: str, meta: dict,
+                 cache: ShardCache):
+        self._dir = data_dir
+        self._split = split
+        self._cache = cache
+        self._files = [s["file"] for s in meta["shards"]]
+        self._starts = np.array([s["start"] for s in meta["shards"]]
+                                + [meta["n"]], np.int64)
+        self.shape = (int(meta["n"]), *(int(d) for d in meta["image_shape"]))
+        self.dtype = np.dtype(meta["image_dtype"])
+        self.ndim = len(self.shape)
+        self.num_shards = len(self._files)
+        #: shard ids this process has actually read — the ownership invariant
+        #: ("no rank reads another rank's bytes") is pinned against this.
+        self.shards_read: set[int] = set()
+
+    @property
+    def cache(self) -> ShardCache:
+        return self._cache
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def _load_shard(self, sid: int) -> np.ndarray:
+        self.shards_read.add(sid)
+        return self._cache.get(
+            (self._split, sid),
+            lambda: np.load(os.path.join(self._dir, self._files[sid])))
+
+    def __getitem__(self, rows):
+        if isinstance(rows, (int, np.integer)):
+            return self[np.array([int(rows)])][0]
+        if isinstance(rows, slice):
+            rows = np.arange(*rows.indices(self.shape[0]))
+        rows = np.asarray(rows)
+        if rows.ndim != 1:
+            raise IndexError("ShardedImages supports 1-D row gathers only")
+        out = np.empty((len(rows), *self.shape[1:]), self.dtype)
+        sids = np.searchsorted(self._starts, rows, side="right") - 1
+        if len(rows) and (rows.min() < 0 or rows.max() >= self.shape[0]):
+            raise IndexError(
+                f"row index out of range for {self.shape[0]} rows")
+        for sid in np.unique(sids):
+            data = self._load_shard(int(sid))
+            sel = sids == sid
+            out[sel] = data[rows[sel] - self._starts[sid]]
+        return out
+
+    def __array__(self, dtype=None, copy=None):
+        # Explicit whole-array materialization (ds.dense(), np.asarray):
+        # bypasses the cache budget by design — callers asking for the dense
+        # copy have already decided it fits (fits_residency / maybe_resident).
+        out = self[np.arange(self.shape[0])]
+        return out if dtype is None else out.astype(dtype)
+
+
+def load_sharded(data_dir: str,
+                 host_cache_bytes: int = DEFAULT_HOST_CACHE_BYTES):
+    """Open a sharded dataset directory: ``(train, test)`` ``ArrayDataset``s
+    whose images are shard-backed virtual arrays sharing ONE decoded-shard
+    cache bounded by ``host_cache_bytes``. uint8 shards stay raw and
+    normalize per batch at assembly (the lazy ``.npy`` convention); float32
+    shards are already in model units."""
+    from .datasets import ArrayDataset
+    manifest = read_manifest(data_dir)
+    norm = None
+    if manifest.get("norm") is not None:
+        norm = (np.asarray(manifest["norm"]["mean"], np.float32),
+                np.asarray(manifest["norm"]["std"], np.float32))
+    cache = ShardCache(host_cache_bytes)
+    out = []
+    for split in ("train", "test"):
+        meta = manifest["splits"].get(split)
+        if meta is None:
+            raise ValueError(f"{manifest_path(data_dir)}: missing split "
+                             f"{split!r}")
+        labels = np.load(os.path.join(data_dir, meta["labels"]["file"]))
+        images = ShardedImages(data_dir, split, meta, cache)
+        ds_norm = norm if images.dtype == np.uint8 else None
+        out.append(ArrayDataset(
+            images=images, labels=np.ascontiguousarray(labels, np.int32),
+            indices=np.arange(meta["n"], dtype=np.int32),
+            num_classes=int(manifest["num_classes"]), norm=ds_norm))
+    return tuple(out)

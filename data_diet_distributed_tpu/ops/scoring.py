@@ -25,7 +25,8 @@ import jax
 import numpy as np
 
 from ..data.datasets import ArrayDataset, make_position_joiner
-from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
+from ..data.pipeline import (BatchSharder, PrefetchIterator, data_plane_record,
+                             device_stream, iterate_batches, merge_stall_stats,
                              num_batches)
 from ..obs import registry as obs_registry
 from ..obs import scoreboard as obs_scoreboard
@@ -155,8 +156,9 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                   eval_mode: bool = True, use_pallas: bool | None = None,
                   score_step=None, device_resident: bool | None = None,
                   chunk_steps: int | None = None,
-                  on_seed_done=None, seed_ids: Sequence[int] | None = None
-                  ) -> np.ndarray:
+                  on_seed_done=None, seed_ids: Sequence[int] | None = None,
+                  data_plane: str = "auto", prefetch_depth: int = 2,
+                  logger=None) -> np.ndarray:
     """Score every example; returns ``scores[N]`` aligned with ``ds`` row order.
 
     ``variables_seeds`` is a sequence of model variable pytrees (one per scoring seed);
@@ -190,6 +192,15 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     ``seed_ids`` labels the passes with the caller's true seed values
     (``compute_scores`` passes its seed list); the pass index is the label
     otherwise.
+
+    ``data_plane`` selects the feed engine (``data.data_plane``): ``"auto"``
+    keeps the size-based residency rule above; ``"resident"`` forces the
+    upload-once path regardless of size; ``"streaming"`` forbids residency
+    and, single-process, runs the chunked engine over ``ScoreStream``
+    blocks — assembled ``prefetch_depth`` blocks ahead and bit-identical to
+    the resident pass — so >HBM (and >host-RAM, via the sharded format's
+    bounded cache) datasets score under a fixed memory budget. A streaming
+    pass logs one ``data_plane`` record through ``logger`` when given.
     """
     mesh = sharder.mesh if sharder is not None else None
     if sharder is not None and len(sharder.axes) < len(mesh.axis_names):
@@ -216,6 +227,13 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
     # bring-your-own id spaces without an O(max_id) table.
     pos_of = make_position_joiner(ds.indices)
 
+    if data_plane == "streaming":
+        # Streaming plane: never hold the dataset on device (or host — the
+        # chunked engine below feeds from ScoreStream, whose blocks flow
+        # through the bounded host cache for sharded datasets).
+        device_resident = False
+    elif data_plane == "resident" and device_resident is None:
+        device_resident = True
     if device_resident is None:
         # Batches shard over every flattened mesh axis, so the per-device
         # budget scales with the full device count.
@@ -224,14 +242,20 @@ def score_dataset(model, variables_seeds: Sequence, ds: ArrayDataset, *,
                            and fits_residency(ds, n_dev))
 
     if not caller_step:
+        # The streaming plane is chunk-capable single-process: ScoreStream
+        # satisfies the block contract resolve_score_chunk_steps gates on.
+        stream_chunks = data_plane == "streaming" and jax.process_count() == 1
         k_chunk = resolve_score_chunk_steps(
-            chunk_steps, num_batches(n, batch_size), bool(device_resident))
+            chunk_steps, num_batches(n, batch_size),
+            bool(device_resident) or stream_chunks)
         if k_chunk > 1:
             return _score_dataset_chunked(
                 model, variables_seeds, ds, method=method,
                 batch_size=batch_size, sharder=sharder, chunk=chunk,
                 eval_mode=eval_mode, use_pallas=use_pallas, k_chunk=k_chunk,
-                on_seed_done=on_seed_done, seed_ids=seed_ids)
+                on_seed_done=on_seed_done, seed_ids=seed_ids,
+                streaming=stream_chunks and not device_resident,
+                prefetch_depth=prefetch_depth, logger=logger)
 
     def device_batches():
         if sharder is not None:
@@ -372,9 +396,76 @@ class ScoreResident:
                 yield self.images[s:e], self.labels[s:e], self.mask[s:e]
 
 
+class ScoreStream:
+    """Streaming twin of ``ScoreResident`` for datasets that must not be
+    materialized: same ``(images, labels, mask)`` block layout, composition
+    and sharding, but each block is assembled from the host dataset by the
+    prefetch thread (``data/pipeline.PrefetchIterator``) and uploaded
+    just-in-time — peak footprint is ~``prefetch_depth + 1`` blocks of
+    ``k_chunk`` batches, host and device, instead of the whole dataset.
+    Blocks come from the SAME host assembler as the per-batch path
+    (``iterate_batches``: dataset order, tail padded with row-0 images,
+    zeroed labels, mask 0), so scores are bit-identical to the resident
+    engine. Re-assembles per seed (multi-seed passes pay host traffic
+    ``n_seeds`` times — the cost of not holding the dataset anywhere).
+    Single-process only, like the chunked engine it feeds."""
+
+    def __init__(self, ds: ArrayDataset, batch_size: int, mesh=None, *,
+                 prefetch_depth: int = 2):
+        if jax.process_count() > 1:
+            raise ValueError("ScoreStream is single-process only")
+        self.ds = ds
+        self.n = len(ds)
+        self.nb = num_batches(self.n, batch_size)
+        self.batch_size = batch_size
+        self.prefetch_depth = prefetch_depth
+        #: Cumulative prefetch stall accounting over every ``blocks()`` pass
+        #: (one per seed) — the scoring ``data_plane`` record's payload.
+        self.stall_stats: dict = {}
+        self.sharding = None
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self.sharding = NamedSharding(mesh,
+                                          P(None, tuple(mesh.axis_names)))
+
+    def _block(self, pend: list[dict]):
+        put = (jax.device_put if self.sharding is None
+               else lambda a: jax.device_put(a, self.sharding))
+        images = np.stack([np.asarray(hb["image"], np.float32)
+                           for hb in pend])
+        labels = np.stack([np.ascontiguousarray(hb["label"], np.int32)
+                           for hb in pend])
+        mask = np.stack([np.asarray(hb["mask"], np.float32) for hb in pend])
+        return put(images), put(labels), put(mask)
+
+    def blocks(self, k_chunk: int):
+        """Prefetched ``(images, labels, mask)`` triples of ``<= k_chunk``
+        batches each — the ``ScoreResident.blocks`` contract, with assembly
+        and upload running ``prefetch_depth`` blocks ahead of dispatch."""
+        def produce():
+            pend: list[dict] = []
+            for hb in iterate_batches(self.ds, self.batch_size,
+                                      shuffle=False):
+                pend.append(hb)
+                if len(pend) == k_chunk:
+                    yield self._block(pend)
+                    pend = []
+            if pend:
+                yield self._block(pend)
+
+        it = PrefetchIterator(produce(), depth=self.prefetch_depth,
+                              stage="score")
+        try:
+            yield from it
+        finally:
+            it.close()
+            merge_stall_stats(self.stall_stats, it.stats())
+
+
 def score_resident_pass(chunk_fn, resident: "ScoreResident", variables,
                         k_chunk: int) -> np.ndarray:
-    """ONE seed's whole scoring pass over a prebuilt ``ScoreResident``:
+    """ONE seed's whole scoring pass over a block feed (``ScoreResident``,
+    or its streaming twin ``ScoreStream`` — same ``blocks()`` contract):
     ``ceil(nb / K)`` chunked dispatches and ONE fetch of the stacked score
     blocks — the epoch's entire device→host traffic. Returns the float64
     ``[n]`` seed vector (float64 exactly represents every float32, so a
@@ -394,15 +485,21 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
                            sharder: BatchSharder | None, chunk: int,
                            eval_mode: bool, use_pallas: bool | None,
                            k_chunk: int, on_seed_done=None,
-                           seed_ids: Sequence[int] | None = None) -> np.ndarray:
-    """The dispatch-free score epoch: the dataset uploaded ONCE as pre-batched
-    pre-sharded blocks (``ScoreResident``), then each seed's whole pass is
-    ``ceil(nb / K)`` chunked dispatches — one, on the default auto sizing —
-    and ONE fetch of the stacked score blocks. Single-process only
+                           seed_ids: Sequence[int] | None = None,
+                           streaming: bool = False, prefetch_depth: int = 2,
+                           logger=None) -> np.ndarray:
+    """The chunked score epoch: each seed's pass is ``ceil(nb / K)`` chunked
+    dispatches — one, on the default auto sizing — and ONE fetch of the
+    stacked score blocks. The block feed is either the dataset uploaded ONCE
+    as pre-batched pre-sharded blocks (``ScoreResident``) or, under
+    ``streaming``, prefetch-assembled just-in-time blocks (``ScoreStream``,
+    bit-identical composition, bounded footprint). Single-process only
     (``resolve_score_chunk_steps`` gates)."""
     mesh = sharder.mesh if sharder is not None else None
     multi = mesh is not None and mesh.size > 1
-    resident = ScoreResident(ds, batch_size, mesh)
+    resident = (ScoreStream(ds, batch_size, mesh,
+                            prefetch_depth=prefetch_depth) if streaming
+                else ScoreResident(ds, batch_size, mesh))
     chunk_fn = make_score_chunk(model, method, mesh if multi else None,
                                 chunk=chunk, eval_mode=eval_mode,
                                 use_pallas=use_pallas)
@@ -415,4 +512,9 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
             method, seed_ids[k] if seed_ids is not None else k, seed_scores)
         if on_seed_done is not None:
             on_seed_done(k, seed_scores)
+    if streaming:
+        record = data_plane_record("score", "chunked_stream",
+                                   resident.stall_stats, ds)
+        if logger is not None:
+            logger.log("data_plane", **record)
     return (total / len(variables_seeds)).astype(np.float32)

@@ -31,8 +31,10 @@ import numpy as np
 from ..checkpoint import CheckpointManager
 from ..config import Config
 from ..data.datasets import ArrayDataset
-from ..data.pipeline import (BatchSharder, device_stream, iterate_batches,
-                             maybe_resident, num_batches)
+from ..data.pipeline import (BatchSharder, EvalBatchCache, StreamingBatches,
+                             data_plane_record, device_stream, iterate_batches,
+                             maybe_resident, merge_stall_stats, num_batches,
+                             prefetch_stream)
 from ..models import create_model_from_cfg
 from ..obs import MetricsLogger, flightrec, tracing
 from ..obs import comm as obs_comm
@@ -86,26 +88,28 @@ def _step_targeted_injection() -> bool:
         for f in ("step_exception_at", "hang_at", "sigterm_at_step"))
 
 
-def resolve_chunk_steps(cfg: Config, steps_per_epoch: int, train_resident,
+def resolve_chunk_steps(cfg: Config, steps_per_epoch: int, train_source,
                         consensus) -> int:
     """The chunked-engine selection policy — returns the chunk size (1 = the
     per-step path).
 
     ``train.chunk_steps``: None = auto (chunking on for single-process
     device-resident runs), 0/1 = forced per-step, K>1 = requested chunk size.
-    Fallbacks to per-step, even when requested: streaming input (the gather
-    the chunk scans over is the RESIDENT gather; ``ResidentBatches`` is also
-    what guarantees single-process), multi-host consensus (its per-step
-    preemption OR-reduce and peer-poison polls are collectives every rank
-    must reach at the same step), and an armed step-targeted fault injection
-    (exact-step coordinates need the per-step loop). The result is clamped to
-    the epoch length (a chunk never crosses an epoch boundary — epoch
-    semantics, eval cadence and checkpointing are unchanged) and to
-    ``MAX_CHUNK_STEPS`` (preemption latency + unrolled program size)."""
+    ``train_source`` is the chunk-capable feed: a ``ResidentBatches`` (the
+    on-device gather) or a ``StreamingBatches`` (prefetched identity blocks —
+    both are single-process by construction); None means per-step input.
+    Fallbacks to per-step, even when requested: no chunk-capable source,
+    multi-host consensus (its per-step preemption OR-reduce and peer-poison
+    polls are collectives every rank must reach at the same step), and an
+    armed step-targeted fault injection (exact-step coordinates need the
+    per-step loop). The result is clamped to the epoch length (a chunk never
+    crosses an epoch boundary — epoch semantics, eval cadence and
+    checkpointing are unchanged) and to ``MAX_CHUNK_STEPS`` (preemption
+    latency + unrolled program size)."""
     k = cfg.train.chunk_steps
     if k is not None and k <= 1:
         return 1
-    if (train_resident is None or consensus is not None
+    if (train_source is None or consensus is not None
             or _step_targeted_injection()):
         return 1
     if k is None:
@@ -173,10 +177,34 @@ def _image_dtype(cfg: Config):
 
 def _train_resident(cfg: Config, ds: ArrayDataset, mesh, sharder: BatchSharder):
     """The train-set residency policy — ONE place, used by ``fit`` and by the
-    multi-seed scoring pretrain that shares an upload across seeds."""
+    multi-seed scoring pretrain that shares an upload across seeds.
+
+    ``data.data_plane``: "streaming" forces None (the streaming plane takes
+    over — chunked prefetched blocks or per-step prefetch); "resident"
+    requires residency (``maybe_resident`` raises where it cannot be honored,
+    and an explicit True bypasses the auto size cap); "auto" keeps the
+    ``train.device_resident_data`` heuristics unchanged."""
+    if cfg.data.data_plane == "streaming":
+        return None
+    enabled = cfg.train.device_resident_data
+    if cfg.data.data_plane == "resident" and enabled is None:
+        enabled = True
     return maybe_resident(ds, mesh, sharder.global_batch_size_for(
-        cfg.data.batch_size), _image_dtype(cfg),
-        enabled=cfg.train.device_resident_data)
+        cfg.data.batch_size), _image_dtype(cfg), enabled=enabled)
+
+
+def _train_stream(cfg: Config, ds: ArrayDataset, mesh, sharder: BatchSharder,
+                  consensus) -> StreamingBatches | None:
+    """The chunked streaming plane's gate — engaged only on an explicit
+    ``data.data_plane=streaming``, single-process, no consensus (the chunked
+    engine's own gates), and no step-targeted fault injection."""
+    if (cfg.data.data_plane != "streaming" or jax.process_count() > 1
+            or consensus is not None or _step_targeted_injection()):
+        return None
+    return StreamingBatches(ds, mesh,
+                            sharder.global_batch_size_for(cfg.data.batch_size),
+                            _image_dtype(cfg),
+                            prefetch_depth=cfg.data.prefetch_depth)
 
 
 def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Config:
@@ -192,7 +220,8 @@ def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Confi
 
 def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
              batch_size: int, eval_step=None, resident=None,
-             chunk_steps: int = 1) -> dict[str, float]:
+             chunk_steps: int = 1, cache: EvalBatchCache | None = None
+             ) -> dict[str, float]:
     batch_size = sharder.global_batch_size_for(batch_size)
     if resident is not None and resident.batch_size != batch_size:
         raise ValueError(
@@ -212,8 +241,13 @@ def evaluate(model, state: TrainState, ds: ArrayDataset, sharder: BatchSharder,
         window = 1 << 30
     else:
         eval_step = eval_step or make_eval_step(model)
+        # ``cache``: reuse the test set's device batches across epochs when
+        # the eval geometry is unchanged (EvalBatchCache) — the non-resident
+        # path otherwise re-assembles and re-uploads the whole set every eval.
         batches = (resident() if resident is not None else
-                   (db for _, db in device_stream(ds, batch_size, sharder)))
+                   cache.stream(ds, batch_size, sharder) if cache is not None
+                   else (db for _, db in device_stream(ds, batch_size,
+                                                       sharder)))
         outs = (eval_step(state, b) for b in batches)
         # Dispatch ahead, fetch in bounded windows: one host round trip per
         # window (per-scalar float() syncs are ruinous on high-latency device
@@ -382,23 +416,39 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
         # scoring pretrains share one upload across seeds) is used as-is.
         if train_resident is None:
             train_resident = _train_resident(cfg, train_ds, mesh, sharder)
+        # Streaming data plane (data.data_plane=streaming): chunked prefetched
+        # blocks when the chunked engine's gates hold, per-step prefetch
+        # otherwise; nothing dataset-sized is held in HBM either way.
+        train_stream = (None if train_resident is not None else
+                        _train_stream(cfg, train_ds, mesh, sharder, consensus))
         test_resident = None
+        eval_cache = None
         if test_ds is not None:
             test_resident = maybe_resident(
                 test_ds, mesh,
                 sharder.global_batch_size_for(cfg.data.eval_batch_size),
-                _image_dtype(cfg), enabled=cfg.train.device_resident_data)
+                _image_dtype(cfg),
+                enabled=(False if cfg.data.data_plane == "streaming"
+                         else cfg.train.device_resident_data))
+            if test_resident is None:
+                eval_cache = EvalBatchCache()
 
         # Chunked execution engine: K steps per dispatch when the run is
-        # single-process and device-resident (resolve_chunk_steps documents
-        # the fallbacks). Resolved HERE — after residents exist, before the
+        # single-process and device-resident — or streaming through the
+        # prefetched block plane (resolve_chunk_steps documents the
+        # fallbacks). Resolved HERE — after residents exist, before the
         # watchdog — because the chunk size scales the heartbeat deadline.
         chunk_steps = resolve_chunk_steps(cfg, steps_per_epoch,
-                                          train_resident, consensus)
+                                          train_resident or train_stream,
+                                          consensus)
+        if chunk_steps <= 1:
+            train_stream = None   # per-step streaming prefetches inline
         result.chunk_steps = chunk_steps
         if chunk_steps > 1:
             logger.log("train_chunked", tag=tag, chunk_steps=chunk_steps,
-                       steps_per_epoch=steps_per_epoch)
+                       steps_per_epoch=steps_per_epoch,
+                       engine=("stream" if train_stream is not None
+                               else "resident"))
 
         # Resilience envelope (resilience/): SIGTERM/SIGINT flip a polled flag
         # (final synchronous checkpoint + Preempted), a missed per-step
@@ -431,6 +481,7 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                 cfg.obs.profile_dir, tag, start_epoch=start_epoch,
                 num_epochs=cfg.train.num_epochs,
                 window_chunks=cfg.obs.profile_window_chunks)
+        plane_stats: dict = {}
         with preempt, (watchdog or contextlib.nullcontext()), \
                 tracing.span("fit", cat="fit", tag=tag,
                              epochs=cfg.train.num_epochs):
@@ -441,7 +492,17 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         watchdog=watchdog, preempt=preempt, sentinel=sentinel,
                         consensus=consensus, chunk_steps=chunk_steps,
                         augment=augment, profile=profile,
-                        update_sharding=update_sharding)
+                        update_sharding=update_sharding,
+                        train_stream=train_stream, eval_cache=eval_cache,
+                        plane_stats=plane_stats)
+        # One {"kind": "data_plane"} record per fit: which engine fed the
+        # steps, the prefetch stall accounting (empty for resident — nothing
+        # to stall on), and the bounded host-cache watermark.
+        logger.log("data_plane", tag=tag, **data_plane_record(
+            tag,
+            ("resident" if train_resident is not None else
+             "chunked_stream" if train_stream is not None else "stream"),
+            plane_stats or None, train_ds))
         # Comm telemetry, once per fit AFTER the epochs (the XLA harvest has
         # run by then, so the overlap ratio can read the program's flops):
         # analytic per-step collective bytes + overlap verdict + fetch wall.
@@ -538,6 +599,16 @@ def _dispatch_chunk(chunk_fn, state, resident, idx, mask):
                     jnp.asarray(idx), jnp.asarray(mask))
 
 
+def _dispatch_stream_chunk(chunk_fn, state, block):
+    """The streaming twin of ``_dispatch_chunk``: the prefetched ``ChunkBlock``
+    is already on device, its identity ``idx`` makes the in-scan gather a
+    no-op reorder — the same chunk program (compiled at the block's shapes),
+    so streaming == resident bitwise. Also a test seam (chunk-boundary
+    interposition)."""
+    return chunk_fn(state, block.images, block.labels, block.indices,
+                    block.idx, block.mask)
+
+
 def _flatten_step_metrics(fetched: list[dict],
                           key: str = "examples") -> list[dict]:
     """Fetched step metrics in per-step order: per-chunk entries hold ``[K]``
@@ -560,8 +631,10 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 saved_steps=None, train_resident=None, test_resident=None,
                 steps_per_epoch=None, epoch_hook=None, watchdog=None,
                 preempt=None, sentinel=None, consensus=None, chunk_steps=1,
-                augment=None, profile=None, update_sharding=None):
-    chunk_fn = (make_train_chunk(model, augment, train_resident.out_sharding,
+                augment=None, profile=None, update_sharding=None,
+                train_stream=None, eval_cache=None, plane_stats=None):
+    chunk_source = train_resident if train_resident is not None else train_stream
+    chunk_fn = (make_train_chunk(model, augment, chunk_source.out_sharding,
                                  update_sharding)
                 if chunk_steps > 1 else None)
     # Live-introspection wiring (no-op unless a status server is installed):
@@ -596,113 +669,157 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
             # the chunk boundary (resolve_chunk_steps already routed
             # consensus and step-targeted injection to the per-step path).
             done = 0
-            for idx, mask in train_resident.chunk_indices(
-                    chunk_steps, shuffle=shuffle, seed=cfg.train.seed,
-                    epoch=epoch):
-                if watchdog is not None:
-                    watchdog.beat()
-                unit = epoch * steps_per_epoch + done
-                obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
-                inject.fire("step", epoch=epoch, step=unit)
-                if profile is not None:
-                    profile.tick(epoch)
-                # The span measures the host-side DISPATCH (permutation
-                # upload + enqueue; blocks only when the device queue is
-                # full) — per-chunk dispatch timing in the trace is the
-                # chunked engine's own metric.
-                with tracing.span("chunk", cat="chunk", step=unit,
-                                  k=int(idx.shape[0])), \
-                        obs_registry.timed("chunk_dispatch_s"):
-                    state, metrics = _dispatch_chunk(chunk_fn, state,
-                                                     train_resident, idx, mask)
-                # Recovery-SLO far end: the first dispatched training chunk
-                # after an armed resume (one attribute check when idle).
-                obs_slo.note_training_step(logger=logger)
-                step_metrics.append(metrics)
-                # HBM watermark poll at the chunk boundary (no-op on
-                # backends without memory_stats, e.g. CPU).
-                obs_xla.poll_memory()
-                prev_done, done = done, done + idx.shape[0]
-                # /status progress at the chunk boundary: step + dispatch
-                # counts, the ETA's intra-epoch progress signal.
-                obs_server.note_progress(
-                    step=epoch * steps_per_epoch + done,
-                    dispatches_done=-(-done // chunk_steps))
-                if (done // cfg.train.log_every_steps
-                        > prev_done // cfg.train.log_every_steps):
-                    # The log_every_steps hook, hoisted like the rest: a
-                    # liveness event at the first chunk boundary past each
-                    # logging multiple — host arithmetic only, loss defers to
-                    # the epoch record (as in the resident per-step branch).
-                    logger.log("train_step", tag=tag, epoch=epoch,
-                               step=step_offset + epoch * steps_per_epoch
-                               + done)
-                if _preempt_due(preempt, consensus, unit):
-                    result.state = state
-                    _preempt_exit(preempt, ckpt, state, logger, tag,
-                                  epoch - 1, steps_per_epoch, saved_steps,
-                                  watchdog=watchdog)
+            # Two chunk feeds, one loop: the resident engine yields [K, B]
+            # permutation slices (gather happens on device); the streaming
+            # engine yields prefetched ChunkBlocks (gather happened on the
+            # assembler thread, idx is identity). Same chunk program either
+            # way, so the dispatch accounting below is engine-agnostic.
+            chunk_iter = (
+                train_stream.chunk_blocks(chunk_steps, shuffle=shuffle,
+                                          seed=cfg.train.seed, epoch=epoch)
+                if train_stream is not None else
+                train_resident.chunk_indices(chunk_steps, shuffle=shuffle,
+                                             seed=cfg.train.seed, epoch=epoch))
+            try:
+                for item in chunk_iter:
+                    if train_stream is not None:
+                        idx, mask = item.idx, item.mask
+                    else:
+                        idx, mask = item
+                    if watchdog is not None:
+                        watchdog.beat()
+                    unit = epoch * steps_per_epoch + done
+                    obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
+                    inject.fire("step", epoch=epoch, step=unit)
+                    if profile is not None:
+                        profile.tick(epoch)
+                    # The span measures the host-side DISPATCH (permutation
+                    # upload + enqueue; blocks only when the device queue is
+                    # full) — per-chunk dispatch timing in the trace is the
+                    # chunked engine's own metric.
+                    with tracing.span("chunk", cat="chunk", step=unit,
+                                      k=int(idx.shape[0])), \
+                            obs_registry.timed("chunk_dispatch_s"):
+                        state, metrics = (
+                            _dispatch_stream_chunk(chunk_fn, state, item)
+                            if train_stream is not None else
+                            _dispatch_chunk(chunk_fn, state, train_resident,
+                                            idx, mask))
+                    # Recovery-SLO far end: the first dispatched training chunk
+                    # after an armed resume (one attribute check when idle).
+                    obs_slo.note_training_step(logger=logger)
+                    step_metrics.append(metrics)
+                    # HBM watermark poll at the chunk boundary (no-op on
+                    # backends without memory_stats, e.g. CPU).
+                    obs_xla.poll_memory()
+                    prev_done, done = done, done + idx.shape[0]
+                    # /status progress at the chunk boundary: step + dispatch
+                    # counts, the ETA's intra-epoch progress signal.
+                    obs_server.note_progress(
+                        step=epoch * steps_per_epoch + done,
+                        dispatches_done=-(-done // chunk_steps))
+                    if (done // cfg.train.log_every_steps
+                            > prev_done // cfg.train.log_every_steps):
+                        # The log_every_steps hook, hoisted like the rest: a
+                        # liveness event at the first chunk boundary past each
+                        # logging multiple — host arithmetic only, loss defers
+                        # to the epoch record (as in the resident per-step
+                        # branch).
+                        logger.log("train_step", tag=tag, epoch=epoch,
+                                   step=step_offset + epoch * steps_per_epoch
+                                   + done)
+                    if _preempt_due(preempt, consensus, unit):
+                        result.state = state
+                        _preempt_exit(preempt, ckpt, state, logger, tag,
+                                      epoch - 1, steps_per_epoch, saved_steps,
+                                      watchdog=watchdog)
+            finally:
+                # Preempted/killed mid-epoch the assembler must not outlive
+                # the loop: close() stops and joins the prefetch thread (a
+                # no-op for the resident generator).
+                if train_stream is not None:
+                    chunk_iter.close()
+                    if plane_stats is not None:
+                        merge_stall_stats(plane_stats, chunk_iter.stats())
         else:
-            batches = (train_resident(shuffle=shuffle, seed=cfg.train.seed,
-                                      epoch=epoch)
-                       if train_resident is not None else
-                       (db for _, db in device_stream(
-                           train_ds, batch_size, sharder, shuffle=shuffle,
-                           seed=cfg.train.seed, epoch=epoch)))
-            for i, batch in enumerate(batches):
-                if watchdog is not None:
-                    watchdog.beat()
-                unit = epoch * steps_per_epoch + i
-                # Throttled internally (obs.heartbeat_interval_s): per-step
-                # progress without a per-step fsync.
-                obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
-                if consensus is not None:
-                    # A peer's poison (its watchdog fired) aborts THIS rank
-                    # here, before it enters a collective the poisoned peer
-                    # will never join — PeerPoisoned, not an unbounded hang.
-                    consensus.check_peers(unit)
-                inject.fire("step", epoch=epoch, step=unit)
-                if profile is not None:
-                    profile.tick(epoch)
-                t_disp = time.perf_counter()
-                state, metrics = train_step(state, batch)
-                obs_registry.observe("step_dispatch_s",
-                                     time.perf_counter() - t_disp)
-                # Recovery-SLO far end (see the chunked branch).
-                obs_slo.note_training_step(logger=logger)
-                step_metrics.append(metrics)
-                # Streaming mode: bound dispatch runahead so queued
-                # host-uploaded batches can't pile up in HBM (resident batches
-                # live there anyway). Sync on the step ~8 back, not the newest
-                # — a sliding window keeps the pipeline full instead of
-                # draining it every 8 steps. The whole dict is fetched (three
-                # scalars, still one round trip) so the periodic train_step
-                # log below reads from host memory, never from the device.
-                if train_resident is None and i >= 8:
-                    step_metrics[i - 8] = jax.device_get(step_metrics[i - 8])
-                if (i + 1) % cfg.train.log_every_steps == 0:
-                    # /status progress on the logging cadence (host
-                    # arithmetic only — the per-step path must stay
-                    # dispatch-bound, not observability-bound).
-                    obs_server.note_progress(step=unit + 1,
-                                             dispatches_done=i + 1)
-                    # Log ONLY already-on-host data: float(metrics["loss"]) /
-                    # int(state.step) here would block on the just-dispatched
-                    # step and serialize the pipeline this loop is built to
-                    # keep full. The step index is host arithmetic; the loss
-                    # is the sliding window's lagged fetch when one exists
-                    # (streaming), else deferred to the epoch record.
-                    rec = {"tag": tag, "epoch": epoch,
-                           "step": step_offset + unit + 1}
+            stream_it = None
+            if train_resident is not None:
+                batches = train_resident(shuffle=shuffle, seed=cfg.train.seed,
+                                         epoch=epoch)
+            else:
+                # Host-fed path: assemble + device_put run on the prefetch
+                # thread (depth batches ahead of dispatch); depth 0 degrades
+                # to the old synchronous loop with identical stall accounting.
+                stream_it = prefetch_stream(
+                    train_ds, batch_size, sharder, shuffle=shuffle,
+                    seed=cfg.train.seed, epoch=epoch,
+                    depth=cfg.data.prefetch_depth, stage=tag)
+                batches = (db for _, db in stream_it)
+            try:
+                for i, batch in enumerate(batches):
+                    if watchdog is not None:
+                        watchdog.beat()
+                    unit = epoch * steps_per_epoch + i
+                    # Throttled internally (obs.heartbeat_interval_s): per-step
+                    # progress without a per-step fsync.
+                    obs_heartbeat.beat(step=unit, epoch=epoch, stage=tag)
+                    if consensus is not None:
+                        # A peer's poison (its watchdog fired) aborts THIS rank
+                        # here, before it enters a collective the poisoned peer
+                        # will never join — PeerPoisoned, not an unbounded hang.
+                        consensus.check_peers(unit)
+                    inject.fire("step", epoch=epoch, step=unit)
+                    if profile is not None:
+                        profile.tick(epoch)
+                    t_disp = time.perf_counter()
+                    state, metrics = train_step(state, batch)
+                    obs_registry.observe("step_dispatch_s",
+                                         time.perf_counter() - t_disp)
+                    # Recovery-SLO far end (see the chunked branch).
+                    obs_slo.note_training_step(logger=logger)
+                    step_metrics.append(metrics)
+                    # Streaming mode: bound dispatch runahead so queued
+                    # host-uploaded batches can't pile up in HBM (resident
+                    # batches live there anyway). Sync on the step ~8 back, not
+                    # the newest — a sliding window keeps the pipeline full
+                    # instead of draining it every 8 steps. The whole dict is
+                    # fetched (three scalars, still one round trip) so the
+                    # periodic train_step log below reads from host memory,
+                    # never from the device.
                     if train_resident is None and i >= 8:
-                        rec.update(loss=float(step_metrics[i - 8]["loss"]),
-                                   loss_step_lag=8)
-                    logger.log("train_step", **rec)
-                if _preempt_due(preempt, consensus, unit):
-                    result.state = state
-                    _preempt_exit(preempt, ckpt, state, logger, tag, epoch - 1,
-                                  steps_per_epoch, saved_steps,
-                                  watchdog=watchdog)
+                        step_metrics[i - 8] = jax.device_get(step_metrics[i - 8])
+                    if (i + 1) % cfg.train.log_every_steps == 0:
+                        # /status progress on the logging cadence (host
+                        # arithmetic only — the per-step path must stay
+                        # dispatch-bound, not observability-bound).
+                        obs_server.note_progress(step=unit + 1,
+                                                 dispatches_done=i + 1)
+                        # Log ONLY already-on-host data: float(metrics["loss"])
+                        # / int(state.step) here would block on the
+                        # just-dispatched step and serialize the pipeline this
+                        # loop is built to keep full. The step index is host
+                        # arithmetic; the loss is the sliding window's lagged
+                        # fetch when one exists (streaming), else deferred to
+                        # the epoch record.
+                        rec = {"tag": tag, "epoch": epoch,
+                               "step": step_offset + unit + 1}
+                        if train_resident is None and i >= 8:
+                            rec.update(loss=float(step_metrics[i - 8]["loss"]),
+                                       loss_step_lag=8)
+                        logger.log("train_step", **rec)
+                    if _preempt_due(preempt, consensus, unit):
+                        result.state = state
+                        _preempt_exit(preempt, ckpt, state, logger, tag,
+                                      epoch - 1, steps_per_epoch, saved_steps,
+                                      watchdog=watchdog)
+            finally:
+                # Stop and join the assembler thread on ANY exit (preemption,
+                # injected fault, peer poison) — a leaked producer would spin
+                # on its bounded queue for the life of the process.
+                if stream_it is not None:
+                    stream_it.close()
+                    if plane_stats is not None:
+                        merge_stall_stats(plane_stats, stream_it.stats())
         step_metrics = _flatten_step_metrics(jax.device_get(step_metrics))
         if watchdog is not None:
             watchdog.beat()   # the epoch fetch/eval/checkpoint are progress too
@@ -747,7 +864,7 @@ def _fit_epochs(cfg, train_ds, test_ds, model, state, train_step, eval_step,
                 ev = evaluate(model, state, test_ds, sharder,
                               cfg.data.eval_batch_size,
                               eval_step, resident=test_resident,
-                              chunk_steps=chunk_steps)
+                              chunk_steps=chunk_steps, cache=eval_cache)
             record["test_accuracy"] = ev["accuracy"]
             record["test_loss"] = ev["loss"]
             if watchdog is not None:
@@ -929,7 +1046,8 @@ def load_data_for(cfg: Config):
     train_ds, test_ds = load_dataset(cfg.data.dataset, cfg.data.data_dir,
                                      cfg.data.synthetic_size, seed=cfg.train.seed,
                                      synthetic_noise=cfg.data.synthetic_noise,
-                                     synthetic_clusters=cfg.data.synthetic_clusters)
+                                     synthetic_clusters=cfg.data.synthetic_clusters,
+                                     host_cache_bytes=cfg.data.host_cache_bytes)
     cfg.model.num_classes = train_ds.num_classes
     return train_ds, test_ds
 
@@ -1264,6 +1382,9 @@ def _compute_scores(cfg: Config, train_ds: ArrayDataset, *, mesh, sharder,
                           eval_mode=cfg.score.eval_mode,
                           use_pallas=cfg.score.use_pallas,
                           chunk_steps=cfg.score.chunk_steps,
+                          data_plane=cfg.data.data_plane,
+                          prefetch_depth=cfg.data.prefetch_depth,
+                          logger=logger,
                           on_seed_done=on_seed_done,
                           # A fixed-checkpoint pass has ONE scoring model
                           # that is not seed 0 — label it by pass index.

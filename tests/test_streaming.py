@@ -1,0 +1,369 @@
+"""Streaming data plane (data/pipeline.py + data/sharded.py + ops/scoring.py):
+double-buffered host→device prefetch, the bounded shard cache, and the
+bit-identity contract against the resident engines.
+
+The load-bearing pins:
+
+  streaming fit  == resident fit   (params, opt_state, history — with and
+                                    without on-device augmentation)
+  prefetch depth is numerically inert (per-step depth=2 == depth=0, bitwise)
+  streaming multi-seed score == resident score (el2n AND grand, per-seed
+                                    float64 partials included)
+  host RAM stays under data.host_cache_bytes (LRU evicts, never OOMs)
+  SIGTERM mid-prefetch drains the assembler, saves a durable checkpoint,
+                                    and exits 75
+"""
+
+import importlib.util
+import json
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.checkpoint import CheckpointManager
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.data.pipeline import (BatchSharder,
+                                                     EvalBatchCache,
+                                                     PrefetchIterator,
+                                                     device_stream,
+                                                     merge_stall_stats)
+from data_diet_distributed_tpu.data.sharded import (load_sharded, owned_shards,
+                                                    write_manifest,
+                                                    write_split)
+from data_diet_distributed_tpu.models import create_model
+from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.ops.scoring import score_dataset
+from data_diet_distributed_tpu.resilience import inject
+from data_diet_distributed_tpu.train import loop as loop_mod
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarm_injector():
+    yield
+    inject.deactivate()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mk_cfg(tmp_path, prefix, *extra):
+    return load_config(None, [
+        "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000",
+        f"train.checkpoint_dir={tmp_path}/{prefix}_ckpt",
+        f"obs.metrics_path={tmp_path}/{prefix}_metrics.jsonl",
+        "score.pretrain_epochs=0", "score.batch_size=64", *extra])
+
+
+def _pin(history):
+    keys = ("epoch", "train_loss", "train_accuracy", "test_accuracy")
+    return [{k: rec[k] for k in keys if k in rec} for rec in history]
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _events(path, kind):
+    with open(path) as fh:
+        return [e for e in (json.loads(ln) for ln in fh if ln.strip())
+                if e["kind"] == kind]
+
+
+# ------------------------------------------------------- PrefetchIterator
+
+
+def test_prefetch_iterator_order_stats_and_close():
+    items = list(range(24))
+    it = PrefetchIterator(iter(items), depth=2, stage="unit")
+    assert list(it) == items
+    st = it.stats()
+    assert set(st) == {"stage", "prefetch_depth", "items", "stall_s",
+                       "warmup_s", "elapsed_s", "stall_frac"}
+    assert st["stage"] == "unit" and st["prefetch_depth"] == 2
+    assert st["items"] == 24 and st["stall_s"] >= 0.0
+
+    # depth<=0 is the synchronous baseline: no thread, same item order,
+    # same stats shape.
+    sync = PrefetchIterator(iter(items), depth=0, stage="sync")
+    assert sync._thread is None
+    assert list(sync) == items
+    assert sync.stats()["prefetch_depth"] == 0
+    assert sync.stats()["items"] == 24
+
+    # close() drains an unfinished producer promptly (and is idempotent) —
+    # the assembler thread must not outlive the epoch that abandoned it.
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    it = PrefetchIterator(endless(), depth=2, stage="unit")
+    assert next(it) == 0
+    t0 = time.monotonic()
+    it.close()
+    it.close()
+    assert time.monotonic() - t0 < 5.0
+    it._thread.join(timeout=5.0)
+    assert not it._thread.is_alive()
+
+
+def test_prefetch_iterator_reraises_producer_exception():
+    def boom():
+        yield 1
+        raise RuntimeError("assembler died")
+
+    it = PrefetchIterator(boom(), depth=2, stage="unit")
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="assembler died"):
+        list(it)
+
+
+def test_merge_stall_stats_accumulates_in_place():
+    total = {}
+    merge_stall_stats(total, {"stage": "train", "prefetch_depth": 2,
+                              "items": 4, "stall_s": 1.0, "warmup_s": 0.5,
+                              "elapsed_s": 10.0, "stall_frac": 0.1})
+    merge_stall_stats(total, {"stage": "train", "prefetch_depth": 2,
+                              "items": 4, "stall_s": 3.0, "warmup_s": 0.5,
+                              "elapsed_s": 10.0, "stall_frac": 0.3})
+    assert total["items"] == 8 and total["stall_s"] == 4.0
+    assert total["elapsed_s"] == 20.0 and total["stall_frac"] == 0.2
+
+
+# ------------------------------------------------- fit bit-identity pins
+
+
+@pytest.mark.parametrize("augment", [False, True], ids=["plain", "augment"])
+def test_streaming_fit_bit_identical_to_resident(tmp_path, mesh8, tiny_ds,
+                                                 augment):
+    """The tentpole pin: a chunked streaming fit — blocks assembled on the
+    host and prefetched ahead — must equal the device-resident chunked fit
+    bitwise (params, opt_state, history). Augmentation is a pure function of
+    state.step, so the pin holds with it on too."""
+    train_ds, test_ds = tiny_ds
+    extra = ["train.num_epochs=2", "train.chunk_steps=2"]
+    if augment:
+        extra.append("data.augment=true")
+    cfg_r = _mk_cfg(tmp_path, "res", *extra, "train.device_resident_data=true")
+    cfg_s = _mk_cfg(tmp_path, "str", *extra, "data.data_plane=streaming")
+    res_r = loop_mod.fit(cfg_r, train_ds, test_ds, mesh=mesh8, num_epochs=2)
+    res_s = loop_mod.fit(cfg_s, train_ds, test_ds, mesh=mesh8, num_epochs=2)
+    assert res_r.chunk_steps == 2 and res_s.chunk_steps == 2
+    _assert_trees_equal(res_r.state.params, res_s.state.params)
+    _assert_trees_equal(res_r.state.opt_state, res_s.state.opt_state)
+    assert _pin(res_r.history) == _pin(res_s.history)
+
+
+def test_streaming_fit_emits_data_plane_record(tmp_path, mesh8, tiny_ds):
+    train_ds, _ = tiny_ds
+    cfg = _mk_cfg(tmp_path, "rec", "data.data_plane=streaming",
+                  "train.chunk_steps=2")
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    loop_mod.fit(cfg, train_ds, None, mesh=mesh8, logger=logger)
+    recs = _events(cfg.obs.metrics_path, "data_plane")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["engine"] == "chunked_stream"
+    # 1 epoch of 4 steps at K=2 is 2 prefetched blocks.
+    assert rec["prefetch_depth"] == 2 and rec["items"] == 2
+    for field in ("stage", "engine", "prefetch_depth", "stall_s",
+                  "stall_frac", "host_cache_bytes_in_use"):
+        assert field in rec
+    # The stream passes the KINDS lint (validate_metrics knows data_plane).
+    vm = _load_tool("validate_metrics")
+    assert vm.validate_file(cfg.obs.metrics_path) == []
+
+
+def test_per_step_prefetch_depth_is_numerically_inert(tmp_path, mesh8,
+                                                      tiny_ds):
+    """depth=2 vs depth=0 (synchronous) on the per-step streaming path:
+    prefetch reorders WHEN work happens, never WHAT is computed."""
+    train_ds, _ = tiny_ds
+    base = ["data.data_plane=streaming", "train.chunk_steps=0"]
+    cfg_a = _mk_cfg(tmp_path, "d2", *base, "data.prefetch_depth=2")
+    cfg_b = _mk_cfg(tmp_path, "d0", *base, "data.prefetch_depth=0")
+    res_a = loop_mod.fit(cfg_a, train_ds, None, mesh=mesh8)
+    res_b = loop_mod.fit(cfg_b, train_ds, None, mesh=mesh8)
+    assert res_a.chunk_steps == 1 and res_b.chunk_steps == 1
+    _assert_trees_equal(res_a.state.params, res_b.state.params)
+    assert _pin(res_a.history) == _pin(res_b.history)
+
+
+# ------------------------------------------------ score bit-identity pins
+
+
+@pytest.mark.parametrize("method", ["el2n", "grand"])
+def test_streaming_score_bit_identical_multi_seed(tmp_path, mesh8, tiny_ds,
+                                                  method):
+    """Multi-seed chunked scoring through ScoreStream must equal ScoreResident
+    bitwise — the mean AND each seed's float64 partial (the stage-resume
+    artifacts)."""
+    train_ds, _ = tiny_ds
+    model = create_model("tiny_cnn", train_ds.num_classes)
+    variables = [
+        jax.jit(model.init, static_argnames=("train",))(
+            jax.random.key(s), np.zeros((1, 8, 8, 3), np.float32), train=False)
+        for s in (0, 1)]
+    sharder = BatchSharder(mesh8)
+    partials = {"resident": [], "streaming": []}
+
+    def record(name):
+        def cb(k, seed_scores):
+            partials[name].append((k, np.array(seed_scores)))
+        return cb
+
+    kw = dict(method=method, batch_size=64, sharder=sharder, chunk_steps=3)
+    logger = MetricsLogger(f"{tmp_path}/score_metrics.jsonl", echo=False)
+    s_res = score_dataset(model, variables, train_ds, data_plane="resident",
+                          on_seed_done=record("resident"), **kw)
+    s_str = score_dataset(model, variables, train_ds, data_plane="streaming",
+                          on_seed_done=record("streaming"), logger=logger,
+                          **kw)
+    np.testing.assert_array_equal(s_res, s_str)
+    assert len(partials["resident"]) == len(partials["streaming"]) == 2
+    for (ka, pa), (kb, pb) in zip(partials["resident"],
+                                  partials["streaming"]):
+        assert ka == kb and pa.dtype == np.float64 and pb.dtype == np.float64
+        np.testing.assert_array_equal(pa, pb)
+    recs = _events(f"{tmp_path}/score_metrics.jsonl", "data_plane")
+    assert len(recs) == 1 and recs[0]["engine"] == "chunked_stream"
+    assert recs[0]["stage"] == "score"
+
+
+# ------------------------------------------------------- eval batch cache
+
+
+def test_eval_batch_cache_reuses_device_batches(mesh8, tiny_ds):
+    """Second epoch's eval reuses the SAME device batch objects — the per-eval
+    test-set re-upload the resident docstring complains about is gone."""
+    _, test_ds = tiny_ds
+    sharder = BatchSharder(mesh8)
+    cache = EvalBatchCache()
+    first = list(cache.stream(test_ds, 64, sharder))
+    second = list(cache.stream(test_ds, 64, sharder))
+    assert cache.hits == 1
+    assert all(a is b for a, b in zip(first, second))
+    # Cached batches are the ones a fresh stream would produce.
+    fresh = [db for _, db in device_stream(test_ds, 64, sharder)]
+    assert len(first) == len(fresh) > 0
+    for a, b in zip(first, fresh):
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+    # A geometry change (batch size) is a different key: no false hit.
+    cache.stream(test_ds, 32, sharder)
+    assert cache.hits == 1
+
+
+def test_eval_batch_cache_respects_byte_budget(mesh8, tiny_ds):
+    """Datasets whose device copy would blow the budget stream fresh,
+    uncached — exactly the datasets the streaming plane exists for."""
+    _, test_ds = tiny_ds
+    sharder = BatchSharder(mesh8)
+    small = EvalBatchCache(max_bytes=1024)
+    out = list(small.stream(test_ds, 64, sharder))
+    assert out and small.hits == 0 and small._batches is None
+
+
+# --------------------------------------------- sharded storage invariants
+
+
+def test_owned_shards_partition_disjoint_and_complete():
+    for world in (1, 2, 3, 8):
+        owned = [owned_shards(10, r, world) for r in range(world)]
+        flat = sorted(s for per_rank in owned for s in per_rank)
+        assert flat == list(range(10))
+        assert len({s for per_rank in owned for s in per_rank}) == 10
+
+
+def _write_sharded_f32(out_dir, n=96, shard_size=16, n_test=32, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = rng.normal(size=(n, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    test_imgs = rng.normal(size=(n_test, 8, 8, 3)).astype(np.float32)
+    test_labels = rng.integers(0, 4, n_test).astype(np.int32)
+    splits = {
+        "train": write_split(str(out_dir), "train", imgs, labels, shard_size),
+        "test": write_split(str(out_dir), "test", test_imgs, test_labels,
+                            shard_size),
+    }
+    write_manifest(str(out_dir), splits, 4, None)
+    return imgs, labels
+
+
+def test_sharded_cache_evicts_under_budget_and_rank_reads_stay_owned(
+        tmp_path):
+    imgs, labels = _write_sharded_f32(tmp_path)   # 6 train shards of 12 KiB
+    shard_bytes = 16 * 8 * 8 * 3 * 4
+    train, _ = load_sharded(str(tmp_path), host_cache_bytes=2 * shard_bytes)
+    # A full-epoch gather streams through the 2-shard budget: every value
+    # correct, every shard touched once, cache never over budget.
+    out = train.images[np.arange(len(train))]
+    np.testing.assert_array_equal(out, imgs)
+    np.testing.assert_array_equal(train.labels, labels)
+    cache = train.images.cache
+    assert cache.bytes_in_use <= cache.budget_bytes
+    assert cache.evictions >= 4 and cache.loads == 6
+    assert train.images.shards_read == set(range(6))
+
+    # Ownership invariant: a rank gathering only rows of its owned shards
+    # (shards[rank::world]) never opens another rank's shard files.
+    train2, _ = load_sharded(str(tmp_path), host_cache_bytes=2 * shard_bytes)
+    own = owned_shards(6, 1, 2)
+    rows = np.concatenate([np.arange(s * 16, (s + 1) * 16) for s in own])
+    np.testing.assert_array_equal(train2.images[rows], imgs[rows])
+    assert train2.images.shards_read == set(own)
+
+
+# -------------------------------------------- SIGTERM mid-prefetch drill
+
+
+def test_sigterm_mid_prefetch_saves_durable_checkpoint_exit_75(tmp_path):
+    """SIGTERM landing while the prefetch assembler is live: the epoch's
+    finally-close drains the thread, the handler makes the final synchronous
+    checkpoint, and the CLI maps Preempted to exit 75 — the scheduler
+    contract, unchanged by the streaming plane."""
+    from data_diet_distributed_tpu import cli
+    inject.activate(inject.FaultPlan(sigterm_at_step=2))
+    rc = cli.main([
+        "train", "data.dataset=synthetic", "data.synthetic_size=256",
+        "data.batch_size=64", "data.eval_batch_size=64",
+        "model.arch=tiny_cnn", "optim.lr=0.1", "train.num_epochs=2",
+        "train.half_precision=false", "train.log_every_steps=1000",
+        "data.data_plane=streaming", "train.checkpoint_every=1",
+        f"train.checkpoint_dir={tmp_path}/ckpt",
+        f"obs.metrics_path={tmp_path}/metrics.jsonl",
+        "obs.heartbeat_interval_s=0", "score.pretrain_epochs=0"])
+    assert rc == 75
+    # No assembler thread survives the drain.
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("prefetch:") and t.is_alive()]
+    # The final synchronous save is durable and restorable.
+    mngr = CheckpointManager(f"{tmp_path}/ckpt")
+    try:
+        steps = mngr.all_steps()
+        assert steps and max(steps) >= 2
+        assert mngr.metrics(max(steps))["preempted"] is True
+    finally:
+        mngr.close()
+    pre = _events(f"{tmp_path}/metrics.jsonl", "preempted")
+    assert pre and pre[0]["signal"] == "SIGTERM"

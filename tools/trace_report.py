@@ -105,6 +105,17 @@ def summarize(events: list[dict], *, top_chunks: int = 5,
             "compile_overhead_s": round(max(first_s - steady_mean, 0.0), 4),
             "ratio": round(first_s / steady_mean, 2) if steady_mean else None}
 
+    # Prefetch stalls (data/pipeline.PrefetchIterator): one cat="prefetch"
+    # span per consumer wait on the assembler thread, tagged with the stage
+    # that stalled. p50/p95 per stage says whether the data plane kept up
+    # with dispatch or the loop ran input-bound.
+    prefetch: dict[str, list[float]] = {}
+    for e in by_cat.get("prefetch", []):
+        stage = (e.get("args") or {}).get("stage", "?")
+        prefetch.setdefault(stage, []).append(e["dur"])
+    prefetch_report = {stage: _dur_summary(durs)
+                       for stage, durs in sorted(prefetch.items())}
+
     chunk_spans = sorted(by_cat.get("chunk", []), key=lambda e: -e["dur"])
     slowest = [{"dur_s": round(e["dur"] / 1e6, 4), "pid": e.get("pid"),
                 **(e.get("args") or {})} for e in chunk_spans[:top_chunks]]
@@ -128,6 +139,7 @@ def summarize(events: list[dict], *, top_chunks: int = 5,
     return {"events": len(events), "spans": len(spans),
             "trace_total_s": round(total_s, 3), "stages": stage_report,
             "epochs": epoch_report, "compile_split": compile_split,
+            "prefetch_stalls": prefetch_report,
             "chunks": chunk_report,
             "slowest_chunks": slowest, "gaps": gaps[:5],
             "ranks": sorted({e.get("pid", 0) for e in spans})}
@@ -214,6 +226,10 @@ def render(report: dict, heartbeats: dict[int, dict] | None = None,
             lines.append(f"  {name:<24} " + "  ".join(parts))
         for g, v in sorted(gauges.items()):
             lines.append(f"  {g:<24} {v}")
+    if report.get("prefetch_stalls"):
+        lines.append("prefetch stalls (consumer waited on the assembler):")
+        lines += [_fmt_summary(stage, s)
+                  for stage, s in report["prefetch_stalls"].items()]
     if report["chunks"]:
         lines.append("chunk dispatches:")
         lines.append(_fmt_summary("all chunks", report["chunks"]))

@@ -75,6 +75,11 @@ KNOWN_KINDS = frozenset({
     # SLO-driven fleet-size decision (scale_up/scale_down/at_max) with
     # the evidence that forced it.
     "serve_fleet", "replica_event", "model_refresh", "autoscale_event",
+    # Streaming data plane (data/pipeline.py + ops/scoring.py): one record
+    # per fit/score pass naming the feed engine (resident / stream /
+    # chunked_stream) with prefetch stall accounting and the host shard-cache
+    # watermark.
+    "data_plane",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -143,6 +148,11 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     # values (tick p95, queue depth) may be null on a traffic-free tick —
     # the action and the before/after sizes are universal.
     "autoscale_event": ("action", "replicas_from", "replicas_to"),
+    # Data-plane records. Null-tolerant like xla_program: a resident pass
+    # has no prefetch thread, so stall_s/stall_frac degrade to null — the
+    # KEYS must be present so consumers can rely on the shape.
+    "data_plane": ("stage", "engine", "prefetch_depth", "stall_s",
+                   "stall_frac", "host_cache_bytes_in_use"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
