@@ -63,6 +63,20 @@ class DataConfig:
     # Decoded-shard LRU budget for dataset="sharded" (bytes): a hard host-RAM
     # bound — exceeding it evicts the coldest decoded shard, never OOMs.
     host_cache_bytes: int = 1 << 30
+    # Hardened shard reads (data/sharded.py): every read is digest-verified
+    # against the manifest; a failed read (transient EIO/ENOENT, or a torn
+    # shard's digest mismatch) is retried up to read_retries times with
+    # exponential backoff starting at read_backoff_s. Exhausting the budget
+    # QUARANTINES the shard (loud data_fault + shard_quarantine records) and
+    # aborts the pass with a typed ShardReadError — garbage bytes never
+    # become rows, so they can never become silently-wrong prune decisions.
+    read_retries: int = 2
+    read_backoff_s: float = 0.05
+    # Opt-in degraded mode: a quarantined shard's rows are served as zero
+    # placeholders, DROPPED from the prune decision, and the drop recorded
+    # in the prune-provenance sidecar (auditable degraded scoring instead of
+    # an abort). Off by default — aborting is the safe behavior.
+    skip_quarantined: bool = False
 
     @property
     def num_classes(self) -> int | None:
@@ -693,6 +707,14 @@ class Config:
             raise ValueError(
                 f"data.host_cache_bytes must be > 0, got "
                 f"{self.data.host_cache_bytes}")
+        if self.data.read_retries < 0:
+            raise ValueError(
+                f"data.read_retries must be >= 0, got "
+                f"{self.data.read_retries}")
+        if self.data.read_backoff_s < 0:
+            raise ValueError(
+                f"data.read_backoff_s must be >= 0, got "
+                f"{self.data.read_backoff_s}")
         if not 0.0 <= self.prune.sparsity < 1.0:
             raise ValueError(f"sparsity must be in [0, 1), got {self.prune.sparsity}")
         for s in self.prune.sweep:

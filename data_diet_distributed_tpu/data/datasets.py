@@ -350,18 +350,30 @@ def _load_npy_mmap(data_dir: str):
 def load_dataset(dataset: str, data_dir: str = "./data", synthetic_size: int = 2048,
                  seed: int = 0, synthetic_noise: float = 0.4,
                  synthetic_clusters: int = 1,
-                 host_cache_bytes: int | None = None
+                 host_cache_bytes: int | None = None,
+                 read_retries: int | None = None,
+                 read_backoff_s: float | None = None,
+                 skip_quarantined: bool = False
                  ) -> tuple[ArrayDataset, ArrayDataset]:
     """Return ``(train, test)`` ArrayDatasets (reference: ``data/loader.py:27-43``)."""
     if dataset == "sharded":
         # Sharded on-disk format (data/sharded.py): images stay on disk and
         # gather through an LRU decoded-shard cache bounded by
         # ``host_cache_bytes`` (``data.host_cache_bytes``) — the streaming
-        # data plane's storage layer. ``tools/make_shards.py`` converts.
-        from .sharded import DEFAULT_HOST_CACHE_BYTES, load_sharded
-        return load_sharded(data_dir, host_cache_bytes
-                            if host_cache_bytes is not None
-                            else DEFAULT_HOST_CACHE_BYTES)
+        # data plane's storage layer, behind the digest-verifying retry read
+        # path (``data.read_retries``). ``tools/make_shards.py`` converts.
+        from .sharded import (DEFAULT_HOST_CACHE_BYTES,
+                              DEFAULT_READ_BACKOFF_S, DEFAULT_READ_RETRIES,
+                              load_sharded)
+        return load_sharded(
+            data_dir,
+            host_cache_bytes if host_cache_bytes is not None
+            else DEFAULT_HOST_CACHE_BYTES,
+            read_retries=(read_retries if read_retries is not None
+                          else DEFAULT_READ_RETRIES),
+            read_backoff_s=(read_backoff_s if read_backoff_s is not None
+                            else DEFAULT_READ_BACKOFF_S),
+            skip_quarantined=skip_quarantined)
     if dataset == "npz" and has_npy_splits(data_dir):
         arrays, norm = _load_npy_mmap(data_dir)
         num_classes = int(max(arrays["train"][1].max(),

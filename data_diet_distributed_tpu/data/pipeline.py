@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import registry as obs_registry
 from ..obs import tracing
+from . import sharded
 from .datasets import ArrayDataset
 
 Batch = dict[str, np.ndarray]
@@ -282,6 +283,26 @@ class PrefetchIterator:
             self._exhausted = True
             if self._exc is not None:
                 exc, self._exc = self._exc, None
+                # Fault transparency: the producer died in the assembler
+                # thread — attach WHERE (stage + batch index, plus shard
+                # coordinates when the failure was a typed shard-read error)
+                # so the consumer's traceback names the coordinates instead
+                # of an opaque relayed exception.
+                coords = {"stage": self.stage, "batch_index": self.items,
+                          "split": getattr(exc, "split", None),
+                          "shard": getattr(exc, "shard", None),
+                          "error_class": getattr(exc, "error_class", None)}
+                try:
+                    exc.data_plane_coords = coords
+                except Exception:   # noqa: BLE001 — slotted exceptions
+                    pass
+                if hasattr(exc, "add_note"):
+                    shard = ("" if coords["shard"] is None else
+                             f", {coords['split']} shard {coords['shard']}"
+                             f" [{coords['error_class']}]")
+                    exc.add_note(
+                        f"[prefetch:{self.stage}] raised in the assembler "
+                        f"thread while producing item {self.items}{shard}")
                 raise exc
             raise StopIteration
         if self.items == 0:
@@ -299,7 +320,13 @@ class PrefetchIterator:
         return item
 
     def close(self) -> None:
-        """Stop the assembler and drain the queue (idempotent)."""
+        """Stop the assembler and drain the queue (idempotent).
+
+        Stays prompt even when the producer is parked in a retry-backoff
+        sleep (``sharded._read_verified``): the interrupt event wakes the
+        sleep, the read raises ``error_class="interrupted"``, and the
+        assembler reaches its sentinel within one poll interval instead of
+        serving out the full exponential-backoff schedule."""
         self._stop.set()
         if self._thread is None:
             return
@@ -308,7 +335,14 @@ class PrefetchIterator:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            sharded.interrupt_reads()
+            try:
+                self._thread.join(timeout=10.0)
+            finally:
+                sharded.resume_reads()
+        else:
+            self._thread.join(timeout=10.0)
 
     def __enter__(self) -> "PrefetchIterator":
         return self

@@ -30,8 +30,10 @@ eviction thrash (each shard is loaded at most once per batch).
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -45,6 +47,79 @@ DEFAULT_SHARD_SIZE = 4096
 
 #: Default decoded-shard LRU budget (``data.host_cache_bytes``).
 DEFAULT_HOST_CACHE_BYTES = 1 << 30
+
+#: Hardened read path defaults (``data.read_retries`` / ``data.read_backoff_s``).
+DEFAULT_READ_RETRIES = 2
+DEFAULT_READ_BACKOFF_S = 0.05
+
+
+class ShardReadError(RuntimeError):
+    """A shard read exhausted its retries (or hit a quarantined shard).
+
+    Carries the failure's coordinates so the prefetch layer and the fault
+    records can name exactly what broke: ``split``/``shard``,
+    ``error_class`` (``transient_io`` | ``digest_mismatch`` |
+    ``interrupted`` | ``quarantined``), and ``retries`` consumed."""
+
+    def __init__(self, msg: str, *, split: str, shard: int,
+                 error_class: str, retries: int = 0):
+        super().__init__(msg)
+        self.split = split
+        self.shard = int(shard)
+        self.error_class = error_class
+        self.retries = int(retries)
+
+
+#: Event set when a preemption/drain path wants in-flight retry backoffs to
+#: stop NOW (``PrefetchIterator.close`` arms it before joining the assembler
+#: thread): the backoff wait is an ``Event.wait``, so a wedged retry loop
+#: raises ``ShardReadError(error_class="interrupted")`` within one poll
+#: instead of sleeping out its exponential schedule.
+_READ_INTERRUPT = threading.Event()
+
+
+def interrupt_reads() -> None:
+    """Break any in-flight shard-read retry backoff promptly."""
+    _READ_INTERRUPT.set()
+
+
+def resume_reads() -> None:
+    """Re-arm the retry path after a drain (idempotent)."""
+    _READ_INTERRUPT.clear()
+
+
+#: Fault records pending JSONL emission: library code here has no logger (and
+#: non-zero ranks have no JSONL), so faults are recorded to the flight
+#: recorder IMMEDIATELY on every rank and queued here for the next
+#: ``data_plane`` emission point (fit/score finallys) to drain into the
+#: metrics stream through the process-0-gated logger.
+_PENDING_FAULTS: list[dict] = []
+_PENDING_LOCK = threading.Lock()
+
+
+def _note_fault(kind: str, **fields) -> None:
+    from ..obs import flightrec
+    flightrec.record(kind, **fields)
+    with _PENDING_LOCK:
+        _PENDING_FAULTS.append({"kind": kind, **fields})
+
+
+def drain_fault_records() -> list[dict]:
+    """Pop every pending ``data_fault``/``shard_quarantine`` record (each a
+    dict with its ``kind`` inside) for JSONL emission."""
+    with _PENDING_LOCK:
+        out, _PENDING_FAULTS[:] = list(_PENDING_FAULTS), []
+    return out
+
+
+def _rank() -> int | None:
+    """This process's rank for fault records; None before backend init (the
+    records are null-tolerant — a fault must never crash on introspection)."""
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:   # noqa: BLE001
+        return None
 
 
 def manifest_path(data_dir: str) -> str:
@@ -82,12 +157,21 @@ def _save_atomic(path: str, array: np.ndarray) -> None:
 
 
 def write_split(out_dir: str, split: str, images, labels: np.ndarray,
-                shard_size: int = DEFAULT_SHARD_SIZE) -> dict:
+                shard_size: int = DEFAULT_SHARD_SIZE,
+                prior: dict | None = None,
+                reused: list[str] | None = None) -> dict:
     """Write one split's shards + labels file; returns the split manifest dict.
 
     ``images`` may be any row-sliceable array (ndarray or ``np.memmap``) —
     each shard is materialized one slice at a time, so converting a dataset
     never needs the whole decoded split in RAM.
+
+    ``prior`` (a previous run's split manifest dict) makes the conversion
+    RESUMABLE: a shard whose on-disk digest already matches the prior
+    manifest's entry (same file name, same row span) is reused instead of
+    rewritten — a killed converter resumes instead of restarting from zero,
+    the same promote-verify discipline the checkpoint tiers use. Reused
+    file names are appended to ``reused`` when the caller passes a list.
     """
     os.makedirs(out_dir, exist_ok=True)
     n = len(labels)
@@ -95,12 +179,40 @@ def write_split(out_dir: str, split: str, images, labels: np.ndarray,
         raise ValueError(f"{split}: {len(images)} images vs {n} labels")
     if shard_size <= 0:
         raise ValueError(f"shard_size must be positive, got {shard_size}")
+    prior_shards = {s["file"]: s for s in (prior or {}).get("shards", ())}
     shards = []
     for i, start in enumerate(range(0, n, shard_size)):
         stop = min(start + shard_size, n)
         fname = f"{split}-shard-{i:05d}.npy"
         path = os.path.join(out_dir, fname)
-        _save_atomic(path, np.ascontiguousarray(images[start:stop]))
+        have = prior_shards.get(fname)
+        if (have is not None and have.get("start") == start
+                and have.get("count") == stop - start
+                and os.path.exists(path)
+                and _sha256_file(path) == have.get("sha256")):
+            # Digest-verified reuse: the bytes on disk ARE the manifest's —
+            # the source rows never need materializing.
+            shards.append({"file": fname, "start": start,
+                           "count": stop - start, "sha256": have["sha256"]})
+            if reused is not None:
+                reused.append(fname)
+            continue
+        data = np.ascontiguousarray(images[start:stop])
+        if have is None and os.path.exists(path):
+            # No prior manifest (the converter died before writing one), but
+            # a shard file exists under the final name — ``_save_atomic``
+            # guarantees it is COMPLETE from some run. Reuse it iff its
+            # bytes are exactly what this conversion would write.
+            buf = io.BytesIO()
+            np.save(buf, data)
+            want = hashlib.sha256(buf.getvalue()).hexdigest()
+            if _sha256_file(path) == want:
+                shards.append({"file": fname, "start": start,
+                               "count": stop - start, "sha256": want})
+                if reused is not None:
+                    reused.append(fname)
+                continue
+        _save_atomic(path, data)
         shards.append({"file": fname, "start": start, "count": stop - start,
                        "sha256": _sha256_file(path)})
     labels_file = f"{split}-labels.npy"
@@ -249,20 +361,39 @@ class ShardedImages:
     batch's shard span even when the cache holds a single shard."""
 
     def __init__(self, data_dir: str, split: str, meta: dict,
-                 cache: ShardCache):
+                 cache: ShardCache, *,
+                 read_retries: int = DEFAULT_READ_RETRIES,
+                 read_backoff_s: float = DEFAULT_READ_BACKOFF_S,
+                 skip_quarantined: bool = False):
         self._dir = data_dir
         self._split = split
         self._cache = cache
         self._files = [s["file"] for s in meta["shards"]]
+        #: per-shard manifest digests: EVERY read re-verifies against these
+        #: (the checkpoint-tier discipline applied at read time, not just by
+        #: the offline ``verify_manifest`` pass) — torn bytes can never
+        #: become rows.
+        self._digests = [s["sha256"] for s in meta["shards"]]
         self._starts = np.array([s["start"] for s in meta["shards"]]
                                 + [meta["n"]], np.int64)
         self.shape = (int(meta["n"]), *(int(d) for d in meta["image_shape"]))
         self.dtype = np.dtype(meta["image_dtype"])
         self.ndim = len(self.shape)
         self.num_shards = len(self._files)
+        self.read_retries = max(0, int(read_retries))
+        self.read_backoff_s = float(read_backoff_s)
+        self.skip_quarantined = bool(skip_quarantined)
         #: shard ids this process has actually read — the ownership invariant
         #: ("no rank reads another rank's bytes") is pinned against this.
         self.shards_read: set[int] = set()
+        #: shard ids that exhausted their read retries — loads raise (or,
+        #: under ``skip_quarantined``, return a zero placeholder whose rows
+        #: the prune path drops and records).
+        self.quarantined: set[int] = set()
+        #: retries consumed across all reads (the in-place-recovery ledger
+        #: the data_plane record and run_monitor surface).
+        self.retries_used = 0
+        self._read_counts: dict[int, int] = {}
 
     @property
     def cache(self) -> ShardCache:
@@ -280,10 +411,109 @@ class ShardedImages:
         return self.shape[0]
 
     def _load_shard(self, sid: int) -> np.ndarray:
+        if sid in self.quarantined:
+            if self.skip_quarantined:
+                # Degraded mode: a deterministic zero placeholder, NEVER the
+                # corrupt bytes — the prune path drops these rows from the
+                # keep decision and records the drop in the provenance
+                # sidecar (quarantined_rows names them).
+                count = int(self._starts[sid + 1] - self._starts[sid])
+                return np.zeros((count, *self.shape[1:]), self.dtype)
+            raise ShardReadError(
+                f"{self._split} shard {sid} ({self._files[sid]}) is "
+                "quarantined — refusing to serve rows from it",
+                split=self._split, shard=sid, error_class="quarantined")
         self.shards_read.add(sid)
-        return self._cache.get(
-            (self._split, sid),
-            lambda: np.load(os.path.join(self._dir, self._files[sid])))
+        return self._cache.get((self._split, sid),
+                               lambda: self._read_verified(sid))
+
+    def _read_verified(self, sid: int) -> np.ndarray:
+        """The hardened read: raw bytes -> injection seam -> digest check ->
+        decode, under bounded retry with exponential backoff.
+
+        Failure classes: an ``OSError`` (EIO/ENOENT — flaky storage) is
+        TRANSIENT and retried; a digest mismatch (torn/corrupted bytes) is
+        verified per attempt and retried in case the tear was in the read
+        rather than on disk. A shard that exhausts its retries is
+        QUARANTINED with a loud ``data_fault`` + ``shard_quarantine`` record
+        (flight recorder on every rank, metrics JSONL at the next
+        ``data_plane`` drain) and the pass aborts with ``ShardReadError`` —
+        garbage bytes never become rows. The backoff wait is interruptible
+        (``interrupt_reads``) so a drain/preemption never waits out the
+        schedule."""
+        from ..resilience import inject
+        path = os.path.join(self._dir, self._files[sid])
+        expect = self._digests[sid]
+        retries = self.read_retries
+        last: tuple[str, str] | None = None   # (error_class, detail)
+        for attempt in range(retries + 1):
+            if attempt:
+                self.retries_used += 1
+                delay = self.read_backoff_s * (2 ** (attempt - 1))
+                if delay > 0 and _READ_INTERRUPT.wait(delay):
+                    raise ShardReadError(
+                        f"{self._split} shard {sid}: retry backoff "
+                        "interrupted by drain/preemption",
+                        split=self._split, shard=sid,
+                        error_class="interrupted", retries=attempt - 1)
+            self._read_counts[sid] = k = self._read_counts.get(sid, 0) + 1
+            try:
+                inject.fire("shard_read", shard=sid, split=self._split,
+                            read=k)
+                with open(path, "rb") as fh:
+                    raw = fh.read()
+            except OSError as e:
+                last = ("transient_io", repr(e)[:200])
+                continue
+            raw = inject.transform("shard_read", raw, shard=sid,
+                                   split=self._split, read=k)
+            got = hashlib.sha256(raw).hexdigest()
+            if got != expect:
+                last = ("digest_mismatch",
+                        f"manifest {expect[:12]}…, read {got[:12]}…")
+                continue
+            if attempt:
+                # Recovered in place: no restart, no quarantine — but the
+                # retries and their cause are on the record.
+                _note_fault("data_fault", split=self._split, shard=sid,
+                            rank=_rank(), error_class=last[0] if last
+                            else "transient_io", retries=attempt,
+                            recovered=True, detail=last[1] if last else None)
+            return np.load(io.BytesIO(raw), allow_pickle=False)
+        error_class, detail = last if last is not None else ("unknown", "")
+        self.quarantined.add(sid)
+        _note_fault("data_fault", split=self._split, shard=sid, rank=_rank(),
+                    error_class=error_class, retries=retries, recovered=False,
+                    detail=detail)
+        _note_fault("shard_quarantine", split=self._split, shard=sid,
+                    rank=_rank(), error_class=error_class,
+                    file=self._files[sid])
+        # The quarantine IS the postmortem evidence — dump the ring now, on
+        # this rank, before the abort propagates (same discipline as the
+        # watchdog's fire-time dump).
+        from ..obs import flightrec
+        flightrec.dump(f"shard_quarantine:{self._split}:{sid}")
+        if self.skip_quarantined:
+            # Opt-in degraded mode: the pass continues on a zero placeholder;
+            # the quarantined rows are dropped from the prune decision and
+            # the drop recorded in the provenance sidecar (quarantined_rows).
+            count = int(self._starts[sid + 1] - self._starts[sid])
+            return np.zeros((count, *self.shape[1:]), self.dtype)
+        raise ShardReadError(
+            f"{self._split} shard {sid} ({self._files[sid]}): "
+            f"{error_class} after {retries} retries ({detail}) — shard "
+            "quarantined; rows were NOT served",
+            split=self._split, shard=sid, error_class=error_class,
+            retries=retries)
+
+    def quarantined_rows(self) -> np.ndarray:
+        """Row indices covered by quarantined shards (the set the degraded
+        ``skip_quarantined`` prune path drops and records)."""
+        if not self.quarantined:
+            return np.empty(0, np.int64)
+        return np.concatenate([
+            np.arange(self._starts[sid], self._starts[sid + 1])
+            for sid in sorted(self.quarantined)])
 
     def __getitem__(self, rows):
         if isinstance(rows, (int, np.integer)):
@@ -313,12 +543,17 @@ class ShardedImages:
 
 
 def load_sharded(data_dir: str,
-                 host_cache_bytes: int = DEFAULT_HOST_CACHE_BYTES):
+                 host_cache_bytes: int = DEFAULT_HOST_CACHE_BYTES, *,
+                 read_retries: int = DEFAULT_READ_RETRIES,
+                 read_backoff_s: float = DEFAULT_READ_BACKOFF_S,
+                 skip_quarantined: bool = False):
     """Open a sharded dataset directory: ``(train, test)`` ``ArrayDataset``s
     whose images are shard-backed virtual arrays sharing ONE decoded-shard
     cache bounded by ``host_cache_bytes``. uint8 shards stay raw and
     normalize per batch at assembly (the lazy ``.npy`` convention); float32
-    shards are already in model units."""
+    shards are already in model units. ``read_retries``/``read_backoff_s``/
+    ``skip_quarantined`` parameterize the hardened digest-verifying read
+    path (``data.read_retries`` etc.)."""
     from .datasets import ArrayDataset
     manifest = read_manifest(data_dir)
     norm = None
@@ -333,7 +568,10 @@ def load_sharded(data_dir: str,
             raise ValueError(f"{manifest_path(data_dir)}: missing split "
                              f"{split!r}")
         labels = np.load(os.path.join(data_dir, meta["labels"]["file"]))
-        images = ShardedImages(data_dir, split, meta, cache)
+        images = ShardedImages(data_dir, split, meta, cache,
+                               read_retries=read_retries,
+                               read_backoff_s=read_backoff_s,
+                               skip_quarantined=skip_quarantined)
         ds_norm = norm if images.dtype == np.uint8 else None
         out.append(ArrayDataset(
             images=images, labels=np.ascontiguousarray(labels, np.int32),

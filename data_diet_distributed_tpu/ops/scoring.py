@@ -24,6 +24,7 @@ from collections.abc import Sequence
 import jax
 import numpy as np
 
+from ..data import sharded
 from ..data.datasets import ArrayDataset, make_position_joiner
 from ..data.pipeline import (BatchSharder, PrefetchIterator, data_plane_record,
                              device_stream, iterate_batches, merge_stall_stats,
@@ -504,17 +505,29 @@ def _score_dataset_chunked(model, variables_seeds: Sequence, ds: ArrayDataset,
                                 chunk=chunk, eval_mode=eval_mode,
                                 use_pallas=use_pallas)
     total = np.zeros(resident.n, np.float64)
-    for k, variables in enumerate(variables_seeds):
-        seed_scores = score_resident_pass(chunk_fn, resident, variables,
-                                          k_chunk)
-        total += seed_scores
-        obs_scoreboard.note_seed_scores(
-            method, seed_ids[k] if seed_ids is not None else k, seed_scores)
-        if on_seed_done is not None:
-            on_seed_done(k, seed_scores)
-    if streaming:
-        record = data_plane_record("score", "chunked_stream",
-                                   resident.stall_stats, ds)
-        if logger is not None:
+    fault: str | None = None
+    try:
+        for k, variables in enumerate(variables_seeds):
+            seed_scores = score_resident_pass(chunk_fn, resident, variables,
+                                              k_chunk)
+            total += seed_scores
+            obs_scoreboard.note_seed_scores(
+                method, seed_ids[k] if seed_ids is not None else k,
+                seed_scores)
+            if on_seed_done is not None:
+                on_seed_done(k, seed_scores)
+    except BaseException as err:   # noqa: BLE001 — recorded, then re-raised
+        fault = f"{type(err).__name__}: {err}"[:300]
+        raise
+    finally:
+        # Emitted from finally so an aborted score pass (shard quarantine,
+        # preemption) still reports its stall/fault stats — and any pending
+        # data_fault/shard_quarantine records drain with it.
+        if streaming and logger is not None:
+            for rec in sharded.drain_fault_records():
+                logger.log(rec.pop("kind"), **rec)
+            record = data_plane_record("score", "chunked_stream",
+                                       resident.stall_stats, ds)
+            record["fault"] = fault
             logger.log("data_plane", **record)
     return (total / len(variables_seeds)).astype(np.float32)

@@ -100,6 +100,26 @@ class FaultPlan:
     # drill's "deliberately-regressed model", deterministic by step.
     slow_replica_ms: float | None = None
     slow_if_step: int | None = None
+    # --- storage fault classes (data/sharded.py shard-read seam) --------
+    # Corrupt shard id S: every read of that shard from torn_on_read
+    # onward has its raw bytes deterministically flipped BEFORE the digest
+    # check — the injected twin of a torn/bit-rotted shard file. NOT
+    # fired-once: on-disk corruption does not heal between retries, so the
+    # hardened read path must exhaust its retries, quarantine, and abort
+    # (the supervisor restart disarms the plan via fault_env, which is how
+    # the recovered pass stays clean). Rank-targetable like every class.
+    torn_shard_read: int | None = None
+    torn_on_read: int = 1
+    # Raise OSError(EIO) on read number eio_on_read of shard id S —
+    # fired-once, so the retry's re-read succeeds and recovery happens
+    # IN PLACE (no restart), which is exactly what the transient-EIO drill
+    # pins. Rank-targetable.
+    eio_shard_read: int | None = None
+    eio_on_read: int = 1
+    # Add this much latency to every shard read — the degraded-storage /
+    # slow-NFS twin (drives prefetch stall accounting, the A/B lane
+    # PERFORMANCE.md ledgers). Not fired-once.
+    slow_shard_read_ms: float | None = None
     rank: int | None = None                # target process_index (None = all)
 
 
@@ -201,6 +221,21 @@ class FaultInjector:
                 self.fired.add("partition_replica_after")
                 self.partition_until = (time.monotonic()
                                         + self.plan.partition_seconds)
+        elif site == "shard_read":
+            # Coordinates: shard id + that shard's 1-based read-attempt
+            # count (retries re-read, so attempt 2 of an EIO'd shard is the
+            # recovery read — which must NOT re-trip a fired-once fault).
+            if self.plan.slow_shard_read_ms is not None \
+                    and self._rank_targeted():
+                time.sleep(self.plan.slow_shard_read_ms / 1000.0)
+            s = self.plan.eio_shard_read
+            if s is not None and ctx["shard"] == s \
+                    and ctx["read"] >= self.plan.eio_on_read \
+                    and "eio_shard_read" not in self.fired \
+                    and self._rank_targeted():
+                self.fired.add("eio_shard_read")
+                raise OSError(
+                    5, f"injected EIO on read {ctx['read']} of shard {s}")
         elif site == "checkpoint_saved":
             if self._due("truncate_after_save_step", ctx["step"]):
                 # Barrier on the async save first: truncating a file that is
@@ -237,6 +272,19 @@ class FaultInjector:
         if site == "epoch_loss" and self._due("nan_loss_at_epoch",
                                               ctx["epoch"]):
             return float("nan")
+        if site == "shard_read" and self.plan.torn_shard_read is not None \
+                and ctx["shard"] == self.plan.torn_shard_read \
+                and ctx["read"] >= self.plan.torn_on_read \
+                and self._rank_targeted():
+            # Flip a deterministic spread of bytes in the RAW buffer, before
+            # the reader's digest check — never the decoded rows (the whole
+            # point is that the digest catches this). Persistent within the
+            # process: every (re-)read of the shard is torn the same way.
+            buf = bytearray(value)
+            step = max(1, len(buf) // 7)
+            for i in range(len(buf) // 2, len(buf), step):
+                buf[i] ^= 0xFF
+            return bytes(buf)
         if site == "durable_candidates" and self.plan.hide_latest_durable \
                 and "hide_latest_durable" not in self.fired \
                 and self._rank_targeted() and len(value):

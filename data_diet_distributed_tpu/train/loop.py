@@ -207,6 +207,40 @@ def _train_stream(cfg: Config, ds: ArrayDataset, mesh, sharder: BatchSharder,
                             prefetch_depth=cfg.data.prefetch_depth)
 
 
+def _emit_data_plane(logger, tag: str, engine: str, plane_stats: dict | None,
+                     ds: ArrayDataset | None, fault: str | None = None) -> None:
+    """Emit the per-pass ``data_plane`` record — called from a FINALLY so an
+    aborted pass still reports how far it got (``fault`` names what killed
+    it; null on a clean pass). Drains any ``data_fault``/``shard_quarantine``
+    records the hardened read path queued into the metrics stream first (the
+    flight recorder on every rank already has them from fault time)."""
+    from ..data import sharded as _sharded
+    for rec in _sharded.drain_fault_records():
+        kind = rec.pop("kind")
+        if kind == "data_fault":
+            logger.log("data_fault", **rec)
+        elif kind == "shard_quarantine":
+            logger.log("shard_quarantine", **rec)
+    record = data_plane_record(tag, engine, plane_stats or None, ds)
+    record["fault"] = fault
+    images = getattr(ds, "images", None)
+    retries = getattr(images, "retries_used", 0)
+    quarantined = sorted(getattr(images, "quarantined", ()))
+    if retries:
+        record["read_retries_used"] = int(retries)
+    if quarantined:
+        record["quarantined_shards"] = [int(s) for s in quarantined]
+    logger.log("data_plane", tag=tag, **record)
+
+
+def _quarantined_rows(ds: ArrayDataset) -> np.ndarray:
+    """Rows of ``ds`` backed by quarantined shards (empty for non-sharded
+    datasets) — the set the degraded prune path must drop and record."""
+    images = getattr(ds, "images", None)
+    fn = getattr(images, "quarantined_rows", None)
+    return fn() if fn is not None else np.empty(0, np.int64)
+
+
 def _with_epochs(cfg: Config, num_epochs: int | None, seed: int | None) -> Config:
     if num_epochs is None and seed is None:
         return cfg
@@ -403,6 +437,9 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
     result = FitResult(state=state)
     t_start = time.perf_counter()
     profile = None
+    train_stream = None
+    plane_stats: dict = {}
+    fit_fault: str | None = None
     try:
         augment = ((cfg.data.crop_pad, cfg.data.flip, cfg.train.seed)
                    if cfg.data.augment else None)
@@ -481,7 +518,6 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                 cfg.obs.profile_dir, tag, start_epoch=start_epoch,
                 num_epochs=cfg.train.num_epochs,
                 window_chunks=cfg.obs.profile_window_chunks)
-        plane_stats: dict = {}
         with preempt, (watchdog or contextlib.nullcontext()), \
                 tracing.span("fit", cat="fit", tag=tag,
                              epochs=cfg.train.num_epochs):
@@ -495,14 +531,6 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
                         update_sharding=update_sharding,
                         train_stream=train_stream, eval_cache=eval_cache,
                         plane_stats=plane_stats)
-        # One {"kind": "data_plane"} record per fit: which engine fed the
-        # steps, the prefetch stall accounting (empty for resident — nothing
-        # to stall on), and the bounded host-cache watermark.
-        logger.log("data_plane", tag=tag, **data_plane_record(
-            tag,
-            ("resident" if train_resident is not None else
-             "chunked_stream" if train_stream is not None else "stream"),
-            plane_stats or None, train_ds))
         # Comm telemetry, once per fit AFTER the epochs (the XLA harvest has
         # run by then, so the overlap ratio can read the program's flops):
         # analytic per-step collective bytes + overlap verdict + fetch wall.
@@ -510,7 +538,25 @@ def fit(cfg: Config, train_ds: ArrayDataset, test_ds: ArrayDataset | None = None
             result.state.params, mesh, update_sharding, logger=logger,
             program="train_chunk" if chunk_steps > 1 else "train_step",
             tag=tag)
+    except BaseException as err:
+        # Named (not re-derived in the finally) so the data_plane record can
+        # say WHAT killed the pass, not just that it died.
+        fit_fault = f"{type(err).__name__}: {err}"[:300]
+        raise
     finally:
+        # One {"kind": "data_plane"} record per fit, emitted from the
+        # FINALLY: which engine fed the steps, the prefetch stall accounting
+        # (empty for resident — nothing to stall on), the bounded host-cache
+        # watermark, and — when the pass died — the fault that killed it, so
+        # postmortem timelines show how far the pass got. Any data_fault /
+        # shard_quarantine records the read path queued are drained into the
+        # stream first (they already hit every rank's flight recorder at
+        # fault time).
+        _emit_data_plane(
+            logger, tag,
+            ("resident" if train_resident is not None else
+             "chunked_stream" if train_stream is not None else "stream"),
+            plane_stats, train_ds, fault=fit_fault)
         if profile is not None:
             profile.close()   # a mid-capture exception must stop the profiler
         if ckpt is not None:
@@ -1047,7 +1093,10 @@ def load_data_for(cfg: Config):
                                      cfg.data.synthetic_size, seed=cfg.train.seed,
                                      synthetic_noise=cfg.data.synthetic_noise,
                                      synthetic_clusters=cfg.data.synthetic_clusters,
-                                     host_cache_bytes=cfg.data.host_cache_bytes)
+                                     host_cache_bytes=cfg.data.host_cache_bytes,
+                                     read_retries=cfg.data.read_retries,
+                                     read_backoff_s=cfg.data.read_backoff_s,
+                                     skip_quarantined=cfg.data.skip_quarantined)
     cfg.model.num_classes = train_ds.num_classes
     return train_ds, test_ds
 
@@ -1521,6 +1570,18 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
                               keep=cfg.prune.keep, seed=cfg.train.seed,
                               labels=train_ds.labels,
                               class_balance=cfg.prune.class_balance)
+        # Degraded-storage audit (data.skip_quarantined): rows served as
+        # zero placeholders by a quarantined shard were scored on garbage-
+        # free but MEANINGLESS bytes — they must never survive into the kept
+        # subset, and the drop must be visible in the provenance sidecar so
+        # downstream keep/drop decisions stay auditable.
+        q_rows = _quarantined_rows(train_ds)
+        q_dropped = 0
+        if len(q_rows):
+            q_ids = np.asarray(train_ds.indices)[q_rows]
+            before = len(kept)
+            kept = kept[~np.isin(kept, q_ids)]
+            q_dropped = before - len(kept)
         # Provenance: scores reused from an artifact did NOT come from this
         # cfg's score.method — record where they came from instead.
         loaded_from = score_t.get("loaded_from")
@@ -1533,6 +1594,12 @@ def _retrain_level(cfg: Config, train_ds, test_ds, scores, sparsity: float, *,
             sparsity=float(sparsity), keep=cfg.prune.keep,
             class_balance=cfg.prune.class_balance, seed=cfg.train.seed,
             fingerprint=pipeline_fingerprint(cfg))
+        if len(q_rows):
+            images = getattr(train_ds, "images", None)
+            manifest["quarantined_shards"] = sorted(
+                int(s) for s in getattr(images, "quarantined", ()))
+            manifest["quarantined_rows"] = int(len(q_rows))
+            manifest["quarantined_dropped_from_kept"] = int(q_dropped)
         if is_primary():   # every process holds the full scores; one writes
             # Atomic (temp + rename): a crash mid-write must never leave a
             # truncated npz that a later score.scores_npz reuse trusts.

@@ -64,3 +64,58 @@ def test_soak_smoke_recovers_all_faults(tmp_path):
          "--metrics", str(tmp_path / "absent.jsonl"), "--once"],
         capture_output=True, text=True, timeout=60)
     assert monitor.returncode == 2
+
+
+def test_soak_smoke_streaming_storage_fault_cycle(tmp_path):
+    """The elastic×streaming smoke (--data-plane streaming): a torn train
+    shard mid-pass quarantines and aborts, the supervisor relaunches with
+    the plan disarmed, and the recovered pass streams clean — judged healthy
+    by the monitor AND the postmortem; then a SIGKILL with the streaming
+    plane active restores and completes. The torn cycle's stream must carry
+    the full forensic chain: data_fault -> shard_quarantine -> aborted
+    data_plane (fault attached) -> clean data_plane after the relaunch."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               PYTHONPATH=REPO)
+    workdir = tmp_path / "soak"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "imagenet_soak.py"),
+         "--smoke", "--data-plane", "streaming",
+         "--workdir", str(workdir), "--quiet"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True and report["data_plane"] == "streaming"
+    assert report["faults"] == ["torn", "kill"]
+    assert report["monitor_exits"] == [0, 0]
+    assert report["postmortem_exits"] == [0, 0]
+    by_fault = {c["fault"]: c for c in report["per_cycle"]}
+    # The torn cycle recovered through a supervisor RELAUNCH (the fault is
+    # persistent in-process; only the disarmed attempt can finish).
+    assert by_fault["torn"]["attempts"] >= 2
+    assert "restart" in by_fault["torn"]["elastic_events"]
+    for c in report["per_cycle"]:
+        assert c["stream_problems"] == [], c
+        assert c["exit_class"] == "ok", c
+
+    # Forensic chain in the torn cycle's stream, in order.
+    stream = workdir / "cycle0_torn" / "metrics.jsonl"
+    recs = [json.loads(ln) for ln in open(stream) if ln.strip()]
+    kinds = [r["kind"] for r in recs]
+    assert "data_fault" in kinds and "shard_quarantine" in kinds
+    fault_i = kinds.index("data_fault")
+    assert recs[fault_i]["recovered"] is False
+    assert recs[fault_i]["error_class"] == "digest_mismatch"
+    planes = [r for r in recs if r["kind"] == "data_plane"]
+    aborted = [p for p in planes if p.get("fault")]
+    clean = [p for p in planes if p.get("fault") is None]
+    assert aborted and clean
+    # The recovered pass came AFTER the abort — the monitor's exit-0 verdict
+    # hinges on exactly this ordering.
+    assert recs.index(clean[-1]) > recs.index(aborted[0])
+    # The fault did not re-fire on the relaunched attempt: every record at
+    # attempt >= 1 is fault-free.
+    assert all(p.get("fault") is None for p in planes
+               if (p.get("attempt") or 0) >= 1)

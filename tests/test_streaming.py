@@ -31,11 +31,14 @@ from data_diet_distributed_tpu.data.pipeline import (BatchSharder,
                                                      PrefetchIterator,
                                                      device_stream,
                                                      merge_stall_stats)
-from data_diet_distributed_tpu.data.sharded import (load_sharded, owned_shards,
+from data_diet_distributed_tpu.data.sharded import (ShardReadError,
+                                                    drain_fault_records,
+                                                    load_sharded, owned_shards,
                                                     write_manifest,
                                                     write_split)
 from data_diet_distributed_tpu.models import create_model
 from data_diet_distributed_tpu.obs import MetricsLogger
+from data_diet_distributed_tpu.parallel.mesh import make_mesh
 from data_diet_distributed_tpu.ops.scoring import score_dataset
 from data_diet_distributed_tpu.resilience import inject
 from data_diet_distributed_tpu.train import loop as loop_mod
@@ -47,6 +50,8 @@ REPO = Path(__file__).resolve().parent.parent
 def _disarm_injector():
     yield
     inject.deactivate()
+    drain_fault_records()   # one test's pending faults must not leak into
+    # the next test's metrics stream
 
 
 def _load_tool(name):
@@ -335,6 +340,256 @@ def test_sharded_cache_evicts_under_budget_and_rank_reads_stay_owned(
 
 
 # -------------------------------------------- SIGTERM mid-prefetch drill
+
+
+def _sharded_cfg(tmp_path, shard_dir, prefix, *extra):
+    return load_config(None, [
+        "data.dataset=sharded", f"data.data_dir={shard_dir}",
+        "data.data_plane=streaming", "data.batch_size=32",
+        "data.eval_batch_size=32", "model.arch=tiny_cnn", "optim.lr=0.1",
+        "train.num_epochs=1", "train.half_precision=false",
+        "train.log_every_steps=1000", "train.chunk_steps=2",
+        f"train.checkpoint_dir={tmp_path}/{prefix}_ckpt",
+        f"obs.metrics_path={tmp_path}/{prefix}_metrics.jsonl",
+        "score.pretrain_epochs=0", *extra])
+
+
+# -------------------------------------------- storage fault tolerance
+
+
+def test_transient_eio_read_recovers_in_place(tmp_path):
+    """A transient EIO on one shard read recovers through the bounded
+    retry+backoff loop: verified rows, no quarantine, one recovered=True
+    data_fault record — and the fired-once injection never re-trips."""
+    imgs, _ = _write_sharded_f32(tmp_path)
+    inject.activate(inject.FaultPlan(eio_shard_read=2, eio_on_read=1))
+    train, _ = load_sharded(str(tmp_path), read_backoff_s=0.001)
+    np.testing.assert_array_equal(train.images[np.arange(96)], imgs)
+    assert train.images.retries_used == 1
+    assert train.images.quarantined == set()
+    recs = drain_fault_records()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "data_fault" and rec["recovered"] is True
+    assert rec["error_class"] == "transient_io" and rec["retries"] == 1
+    assert rec["split"] == "train" and rec["shard"] == 2
+    # Fired-once: a cold re-read of the same shard is clean.
+    train2, _ = load_sharded(str(tmp_path), read_backoff_s=0.001)
+    np.testing.assert_array_equal(train2.images[np.arange(32, 48)],
+                                  imgs[32:48])
+    assert train2.images.retries_used == 0 and drain_fault_records() == []
+
+
+def test_digest_mismatch_quarantines_and_never_serves_rows(tmp_path):
+    """Persistent corruption (torn read, digest mismatch on every retry)
+    NEVER yields rows: typed ShardReadError, shard quarantined, refusal on
+    re-access without another read attempt, loud records — and a reader
+    built after the injector disarms reads the same file clean."""
+    imgs, _ = _write_sharded_f32(tmp_path)
+    inject.activate(inject.FaultPlan(torn_shard_read=1))
+    train, _ = load_sharded(str(tmp_path), read_retries=1,
+                            read_backoff_s=0.0)
+    with pytest.raises(ShardReadError) as ei:
+        train.images[np.arange(16, 32)]
+    err = ei.value
+    assert err.error_class == "digest_mismatch" and err.shard == 1
+    assert err.retries == 1 and "NOT served" in str(err)
+    assert train.images.quarantined == {1}
+    reads_before = dict(train.images._read_counts)
+    with pytest.raises(ShardReadError) as ei2:
+        train.images[np.arange(16, 32)]
+    assert ei2.value.error_class == "quarantined"
+    assert train.images._read_counts == reads_before   # refusal, not re-read
+    kinds = [r["kind"] for r in drain_fault_records()]
+    assert kinds == ["data_fault", "shard_quarantine"]
+    # Other shards still serve verified rows.
+    np.testing.assert_array_equal(train.images[np.arange(16)], imgs[:16])
+    # Disarm (the supervisor-relaunch semantics): a fresh reader is clean.
+    inject.deactivate()
+    train2, _ = load_sharded(str(tmp_path))
+    np.testing.assert_array_equal(train2.images[np.arange(96)], imgs)
+
+
+def test_skip_quarantined_serves_zeros_and_reports_rows(tmp_path):
+    """Opt-in degraded mode: the quarantined shard's rows come back as
+    deterministic zeros (never garbage), quarantined_rows() names exactly
+    the dropped span, and the quarantine records still fire."""
+    imgs, _ = _write_sharded_f32(tmp_path)
+    inject.activate(inject.FaultPlan(torn_shard_read=0))
+    train, _ = load_sharded(str(tmp_path), read_retries=0,
+                            read_backoff_s=0.0, skip_quarantined=True)
+    out = train.images[np.arange(96)]
+    assert (out[:16] == 0).all()
+    np.testing.assert_array_equal(out[16:], imgs[16:])
+    np.testing.assert_array_equal(train.images.quarantined_rows(),
+                                  np.arange(16))
+    kinds = [r["kind"] for r in drain_fault_records()]
+    assert "shard_quarantine" in kinds
+
+
+def test_prefetch_reraises_shard_error_with_coordinates(tmp_path, mesh8):
+    """Tentpole (c): a ShardReadError thrown in the assembler thread
+    re-raises in the consumer with stage/batch/shard coordinates attached —
+    and the assembler thread does not survive the failure."""
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import prefetch_stream
+    _write_sharded_f32(tmp_path)
+    inject.activate(inject.FaultPlan(torn_shard_read=1))
+    train, _ = load_dataset("sharded", str(tmp_path), read_retries=0,
+                            read_backoff_s=0.0)
+    it = prefetch_stream(train, 96, BatchSharder(mesh8), depth=2,
+                         stage="train")
+    with pytest.raises(ShardReadError) as ei:
+        list(it)
+    coords = ei.value.data_plane_coords
+    assert coords["stage"] == "train" and coords["shard"] == 1
+    assert coords["error_class"] == "digest_mismatch"
+    it._thread.join(timeout=5.0)
+    assert not it._thread.is_alive()
+
+
+def test_prefetch_close_interrupts_wedged_retry_backoff(tmp_path, mesh8):
+    """close() must stay prompt when the producer is deep in a retry-backoff
+    schedule (50 retries x 0.5 s): the interrupt event wakes the sleep and
+    the assembler drains in well under the schedule's wall."""
+    from data_diet_distributed_tpu.data import sharded as sharded_mod
+    from data_diet_distributed_tpu.data.datasets import load_dataset
+    from data_diet_distributed_tpu.data.pipeline import prefetch_stream
+    _write_sharded_f32(tmp_path)
+    inject.activate(inject.FaultPlan(torn_shard_read=0))
+    train, _ = load_dataset("sharded", str(tmp_path), read_retries=50,
+                            read_backoff_s=0.5)
+    it = prefetch_stream(train, 32, BatchSharder(mesh8), depth=2,
+                         stage="train")
+    time.sleep(0.3)   # let the assembler reach the retry loop
+    t0 = time.monotonic()
+    it.close()
+    assert time.monotonic() - t0 < 5.0
+    assert not it._thread.is_alive()
+    # The interrupt is scoped to the close: later readers are not poisoned.
+    assert not sharded_mod._READ_INTERRUPT.is_set()
+    drain_fault_records()
+
+
+def test_torn_fit_aborts_with_records_then_disarmed_rerun_matches(
+        tmp_path, mesh8):
+    """The storage-fault cycle at the fit level: a torn shard aborts the
+    pass (rows never served) but the finally-emitted data_plane record
+    still reports the pass WITH the fault attached, alongside mirrored
+    data_fault/shard_quarantine records — all schema-valid. After the
+    injector disarms (the supervisor-relaunch semantics) a rerun over the
+    same shard store is bit-identical to a never-faulted control run."""
+    _write_sharded_f32(tmp_path / "shards")
+    shard_dir = tmp_path / "shards"
+
+    cfg_c = _sharded_cfg(tmp_path, shard_dir, "control")
+    train_c, test_c = loop_mod.load_data_for(cfg_c)
+    res_c = loop_mod.fit(cfg_c, train_c, test_c, mesh=mesh8)
+
+    inject.activate(inject.FaultPlan(torn_shard_read=3))
+    cfg_t = _sharded_cfg(tmp_path, shard_dir, "torn")
+    train_t, test_t = loop_mod.load_data_for(cfg_t)
+    logger = MetricsLogger(cfg_t.obs.metrics_path, echo=False)
+    with pytest.raises(ShardReadError):
+        loop_mod.fit(cfg_t, train_t, test_t, mesh=mesh8, logger=logger)
+    logger.close()
+    planes = _events(cfg_t.obs.metrics_path, "data_plane")
+    assert len(planes) == 1 and planes[0]["fault"] is not None
+    assert "ShardReadError" in planes[0]["fault"]
+    assert planes[0]["quarantined_shards"] == [3]
+    faults = _events(cfg_t.obs.metrics_path, "data_fault")
+    quars = _events(cfg_t.obs.metrics_path, "shard_quarantine")
+    assert faults and faults[-1]["error_class"] == "digest_mismatch"
+    assert quars and quars[0]["shard"] == 3
+    # Satellite 5: the validator accepts a REAL injected-fault stream.
+    vm = _load_tool("validate_metrics")
+    assert vm.validate_file(cfg_t.obs.metrics_path) == []
+
+    inject.deactivate()
+    cfg_r = _sharded_cfg(tmp_path, shard_dir, "rerun")
+    train_r, test_r = loop_mod.load_data_for(cfg_r)
+    res_r = loop_mod.fit(cfg_r, train_r, test_r, mesh=mesh8)
+    _assert_trees_equal(res_c.state.params, res_r.state.params)
+    assert _pin(res_c.history) == _pin(res_r.history)
+
+
+def test_eio_fit_records_in_place_recovery(tmp_path, mesh8):
+    """A transient EIO during a streaming fit recovers WITHOUT a restart:
+    the fit completes, the data_plane record is clean (fault null) but
+    carries read_retries_used, and a recovered=True data_fault record
+    rides the same stream."""
+    _write_sharded_f32(tmp_path / "shards")
+    inject.activate(inject.FaultPlan(eio_shard_read=2, eio_on_read=1))
+    cfg = _sharded_cfg(tmp_path, tmp_path / "shards", "eio",
+                       "data.read_backoff_s=0.001")
+    train_ds, test_ds = loop_mod.load_data_for(cfg)
+    logger = MetricsLogger(cfg.obs.metrics_path, echo=False)
+    loop_mod.fit(cfg, train_ds, test_ds, mesh=mesh8, logger=logger)
+    logger.close()
+    planes = _events(cfg.obs.metrics_path, "data_plane")
+    assert len(planes) == 1 and planes[0]["fault"] is None
+    assert planes[0]["read_retries_used"] >= 1
+    assert "quarantined_shards" not in planes[0]
+    faults = _events(cfg.obs.metrics_path, "data_fault")
+    assert len(faults) == 1 and faults[0]["recovered"] is True
+    assert faults[0]["error_class"] == "transient_io"
+    vm = _load_tool("validate_metrics")
+    assert vm.validate_file(cfg.obs.metrics_path) == []
+
+
+def test_world2_checkpoint_resumes_world1_streaming_bit_identical(tmp_path):
+    """Tentpole (d): the elastic×streaming shrink. A checkpoint written by a
+    world-2 streaming fit restores at world 1 and the CONTINUED streaming
+    fit is bit-identical to a fresh world-1 continuation from the same host
+    values — and the world-1 reader re-derives ownership of EVERY shard."""
+    shard_dir = tmp_path / "shards"
+    _write_sharded_f32(shard_dir)
+    mesh2 = make_mesh(None, devices=jax.devices()[:2])
+    mesh1 = make_mesh(None, devices=jax.devices()[:1])
+
+    # World 2: one streaming epoch, checkpoint at the epoch boundary.
+    cfg_w2 = _sharded_cfg(tmp_path, shard_dir, "w2",
+                          "train.checkpoint_every=1", "train.num_epochs=1")
+    train2, test2 = loop_mod.load_data_for(cfg_w2)
+    res_w2 = loop_mod.fit(cfg_w2, train2, test2, mesh=mesh2,
+                          checkpoint_dir=cfg_w2.train.checkpoint_dir)
+    # Each world-2 rank's owned shards are disjoint and the shrink target
+    # owns their union — the re-derivation is a pure function of (world,
+    # rank), nothing persisted.
+    assert sorted(owned_shards(6, 0, 2) + owned_shards(6, 1, 2)) \
+        == owned_shards(6, 0, 1) == list(range(6))
+
+    # Continuation A: restore the world-2 checkpoint at world 1.
+    cfg_a = _sharded_cfg(tmp_path, shard_dir, "contA", "train.resume=true",
+                         "train.num_epochs=2")
+    train_a, test_a = loop_mod.load_data_for(cfg_a)
+    res_a = loop_mod.fit(cfg_a, train_a, test_a, mesh=mesh1,
+                         checkpoint_dir=cfg_w2.train.checkpoint_dir)
+    # Ownership re-derived: the lone survivor read EVERY train shard.
+    assert train_a.images.shards_read == set(range(6))
+
+    # Continuation B: the same host values written by a WORLD-1 placement
+    # (what a run born at world 1 would have checkpointed), then the same
+    # continuation — the fresh-world-N ground truth.
+    host_state = jax.device_get(res_w2.state)
+    placed = loop_mod.place_state(
+        host_state, mesh1, shard_opt_state=cfg_w2.mesh.shard_opt_state,
+        update_sharding=loop_mod.resolve_update_sharding(cfg_w2.mesh, mesh1))
+    ckpt_b = f"{tmp_path}/w1_ckpt"
+    mngr = CheckpointManager(ckpt_b)
+    mngr.save(int(placed.step), placed,
+              metrics={"epoch": 0, "steps_per_epoch": 3})
+    assert mngr.all_steps() == [int(placed.step)]
+    mngr.close()
+    cfg_b = _sharded_cfg(tmp_path, shard_dir, "contB", "train.resume=true",
+                         "train.num_epochs=2")
+    train_b, test_b = loop_mod.load_data_for(cfg_b)
+    res_b = loop_mod.fit(cfg_b, train_b, test_b, mesh=mesh1,
+                         checkpoint_dir=ckpt_b)
+
+    _assert_trees_equal(res_a.state.params, res_b.state.params)
+    _assert_trees_equal(res_a.state.opt_state, res_b.state.opt_state)
+    assert _pin(res_a.history) == _pin(res_b.history)
 
 
 def test_sigterm_mid_prefetch_saves_durable_checkpoint_exit_75(tmp_path):

@@ -135,10 +135,41 @@ FAULTS = {
     "nan": {"nan_loss_at_epoch": 1},
     "hang": {"hang_at": 3, "hang_seconds": 600.0},
     "rejoin": {"rejoin_after_stage": "score"},
+    # Storage fault (needs --data-plane streaming): persistent torn reads of
+    # one mid-range train shard — the digest check quarantines it, the pass
+    # aborts with a typed ShardReadError, and the supervisor's relaunch
+    # (fault_env disarms the plan at attempt > 0) reads it clean.
+    "torn": {"torn_shard_read": 3},
 }
 
 SMOKE_SCHEDULE = "sigterm,nan,kill"
+#: The elastic×streaming smoke: the torn-shard storage cycle PLUS a SIGKILL
+#: with the streaming plane active (prefetch threads must not outlive the
+#: kill; the relaunch restores and streams clean).
+SMOKE_STREAMING_SCHEDULE = "torn,kill"
 SOAK_SCHEDULE = "sigterm,nan,kill,rejoin,hang,none"
+
+
+def _ensure_smoke_shards(workdir: str) -> str:
+    """The streaming smoke's dataset: the tiny synthetic train workload
+    converted ONCE into the sharded on-disk format (8 train shards of 16
+    rows), shared read-only by every cycle."""
+    from data_diet_distributed_tpu.data import sharded
+    from data_diet_distributed_tpu.data.datasets import _synthetic
+    shard_dir = os.path.join(workdir, "shards")
+    if sharded.is_sharded_dir(shard_dir) \
+            and not sharded.verify_manifest(shard_dir):
+        return shard_dir
+    train_x, train_y = _synthetic(128, 10, 0, "train", 32)
+    test_x, test_y = _synthetic(32, 10, 0, "test", 32)
+    splits = {
+        "train": sharded.write_split(shard_dir, "train", train_x, train_y,
+                                     shard_size=16),
+        "test": sharded.write_split(shard_dir, "test", test_x, test_y,
+                                    shard_size=16),
+    }
+    sharded.write_manifest(shard_dir, splits, 10, None)
+    return shard_dir
 
 
 def _cycle_overrides(args, cycle_dir: str, fault: str) -> list[str]:
@@ -175,11 +206,21 @@ def _cycle_overrides(args, cycle_dir: str, fault: str) -> list[str]:
     ]
     if args.smoke:
         over += [
-            "data.dataset=synthetic", "data.synthetic_size=128",
             "data.batch_size=64", "data.eval_batch_size=64",
             "model.arch=tiny_cnn", "optim.lr=0.05", "train.num_epochs=3",
             "score.pretrain_epochs=0", "score.batch_size=64",
         ]
+        if args.data_plane == "streaming":
+            # The elastic×streaming lane: same tiny workload, fed from the
+            # digest-verified shard store through the prefetch plane.
+            over += [
+                "data.dataset=sharded",
+                f"data.data_dir={os.path.join(args.workdir, 'shards')}",
+                "data.data_plane=streaming",
+                "data.read_backoff_s=0.01",
+            ]
+        else:
+            over += ["data.dataset=synthetic", "data.synthetic_size=128"]
     else:
         over += [
             "data.dataset=npz", f"data.data_dir={args.data_dir}",
@@ -268,9 +309,12 @@ def soak_main(args) -> int:
         if not have:
             generate(args.data_dir, args.rows, args.image_size,
                      args.classes, args.seed)
+    default_schedule = (SOAK_SCHEDULE if not args.smoke
+                        else SMOKE_STREAMING_SCHEDULE
+                        if args.data_plane == "streaming"
+                        else SMOKE_SCHEDULE)
     schedule = [f.strip() for f in
-                (args.schedule or (SMOKE_SCHEDULE if args.smoke
-                                   else SOAK_SCHEDULE)).split(",") if f.strip()]
+                (args.schedule or default_schedule).split(",") if f.strip()]
     unknown = [f for f in schedule if f not in FAULTS]
     if unknown:
         raise SystemExit(f"unknown fault(s) {unknown}; known: "
@@ -278,6 +322,8 @@ def soak_main(args) -> int:
     if args.cycles:
         schedule = (schedule * args.cycles)[: args.cycles]
     os.makedirs(args.workdir, exist_ok=True)
+    if args.smoke and args.data_plane == "streaming":
+        _ensure_smoke_shards(args.workdir)
     driver_log = JsonlLogger(os.path.join(args.workdir, "soak.jsonl"),
                              echo=not args.quiet)
     t0 = time.perf_counter()
@@ -339,6 +385,7 @@ def soak_main(args) -> int:
         "postmortem_exits": [c["postmortem_exit"] for c in cycles],
         "recovery_wall_s": [c["wall_s"] for c in cycles],
         "world": args.world, "smoke": bool(args.smoke),
+        "data_plane": args.data_plane,
         "wall_s": round(time.perf_counter() - t0, 1),
         "per_cycle": cycles,
     }
@@ -372,6 +419,12 @@ def main() -> None:
                              "--soak")
     parser.add_argument("--workdir", default="/tmp/ddt_soak",
                         help="soak working directory (one subdir per cycle)")
+    parser.add_argument("--data-plane", default="resident",
+                        choices=["resident", "streaming"],
+                        help="smoke-cycle feed: resident (synthetic in-RAM, "
+                             "the default) or streaming (digest-verified "
+                             "shard store + prefetch plane; default "
+                             f"schedule {SMOKE_STREAMING_SCHEDULE})")
     parser.add_argument("--command", default=None,
                         help="CLI command each cycle drives (default: "
                              "train in smoke, run otherwise)")

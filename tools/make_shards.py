@@ -86,15 +86,38 @@ def _load_source(args):
 
 def convert(args) -> int:
     splits_src, num_classes, norm = _load_source(args)
+    # Resumable conversion: a prior manifest in --out (an interrupted or
+    # repeated run) lets write_split reuse any shard whose on-disk digest
+    # already matches — only missing/divergent shards are rewritten.
+    prior_splits: dict = {}
+    if sharded.is_sharded_dir(args.out):
+        try:
+            prior_splits = sharded.read_manifest(args.out).get("splits", {})
+        except (OSError, ValueError, json.JSONDecodeError):
+            prior_splits = {}   # unreadable prior: full rewrite
     split_meta = {}
+    reused: dict[str, list[str]] = {}
     for split, (images, labels) in splits_src.items():
+        reused[split] = []
         split_meta[split] = sharded.write_split(
             args.out, split, images, np.asarray(labels, np.int32),
-            shard_size=args.shard_size)
+            shard_size=args.shard_size, prior=prior_splits.get(split),
+            reused=reused[split])
     path = sharded.write_manifest(args.out, split_meta, num_classes, norm)
+    # Record the reuse in the manifest so --verify can report it later.
+    from data_diet_distributed_tpu.utils.io import atomic_write_json
+    manifest = sharded.read_manifest(args.out)
+    manifest["conversion"] = {
+        "resumed": any(reused.values()),
+        "reused": {s: names for s, names in reused.items() if names},
+        "rewritten": {s: len(m["shards"]) - len(reused[s])
+                      for s, m in split_meta.items()},
+    }
+    atomic_write_json(path, manifest)
     print(json.dumps({
         "manifest": path,
         "splits": {s: {"n": m["n"], "shards": len(m["shards"]),
+                       "reused": len(reused[s]),
                        "image_dtype": m["image_dtype"]}
                    for s, m in split_meta.items()},
         "num_classes": num_classes,
@@ -115,6 +138,11 @@ def verify(target: str) -> int:
     print(f"OK: {target}: "
           + ", ".join(f"{s}[n={m['n']}, {len(m['shards'])} shards]"
                       for s, m in manifest["splits"].items()))
+    conv = manifest.get("conversion") or {}
+    if conv.get("resumed"):
+        for split, names in sorted((conv.get("reused") or {}).items()):
+            print(f"resumed conversion reused {len(names)} {split} "
+                  f"shard(s): {', '.join(names)}")
     return 0
 
 

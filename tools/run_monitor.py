@@ -125,7 +125,9 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                                       "fleet_status", "summary",
                                       "elastic_event", "soak_report",
                                       "serve_fleet", "replica_event",
-                                      "model_refresh", "autoscale_event"))
+                                      "model_refresh", "autoscale_event",
+                                      "data_plane", "data_fault",
+                                      "shard_quarantine"))
         view = None
         if lineage:
             from data_diet_distributed_tpu.obs.timeline import (lineage_view,
@@ -221,6 +223,36 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                 "replicas": last.get("replicas_to"),
                 "last_reasons": last.get("reasons"),
             }
+        planes = [r for r in recs if r.get("kind") == "data_plane"]
+        faults = [r for r in recs if r.get("kind") == "data_fault"]
+        quarantines = [r for r in recs
+                       if r.get("kind") == "shard_quarantine"]
+        if planes or faults or quarantines:
+            # Unlike elastic/serve churn, a quarantine DOES gate the
+            # verdict: a shard the data plane gave up on means rows were
+            # dropped or a pass aborted. It clears only when a LATER
+            # data_plane record shows a clean pass (fault null) — the
+            # supervisor restarted and the plane recovered.
+            last_q = max((i for i, r in enumerate(recs)
+                          if r.get("kind") == "shard_quarantine"),
+                         default=None)
+            recovered = last_q is not None and any(
+                r.get("kind") == "data_plane" and r.get("fault") is None
+                for r in recs[last_q + 1:])
+            last_plane = planes[-1] if planes else {}
+            out["data_plane"] = {
+                "engine": last_plane.get("engine"),
+                "stall_frac": last_plane.get("stall_frac"),
+                "faults": len(faults),
+                "retried": sum(bool(r.get("recovered")) for r in faults),
+                "quarantines": len(quarantines),
+                "quarantined_shards": sorted({(r.get("split"), r.get("shard"))
+                                              for r in quarantines},
+                                             key=str),
+                "last_fault": (faults[-1].get("error_class")
+                               if faults else None),
+                "recovered": recovered if quarantines else None,
+            }
         soak = [r for r in recs if r.get("kind") == "soak_report"]
         if soak:
             out["soak_report"] = {k: soak[-1].get(k)
@@ -279,6 +311,13 @@ def decide_exit(info: dict, stale_after_s: float) -> int:
         # point of elastic — but an attempt that exists with no supervisor
         # record explaining it means evidence was lost or something
         # relaunched outside the control plane: out of contract.
+        return EXIT_SLO
+    plane = info.get("data_plane")
+    if plane and plane.get("quarantines") and not plane.get("recovered"):
+        # A shard the data plane quarantined and never cleanly read past:
+        # the stream's last word on storage is "rows missing or pass
+        # aborted". Recovered-then-clean (a later fault-null data_plane
+        # record) is healthy, same shape as the elastic lineage judgment.
         return EXIT_SLO
     return EXIT_HEALTHY
 
@@ -371,6 +410,17 @@ def render(info: dict) -> str:
                      f"recovery(ies), lost wall {lin['lost_wall_s']}s")
         for u in lin["unexplained"]:
             lines.append(f"  UNEXPLAINED: {u}")
+    dp = info.get("data_plane")
+    if dp:
+        q = dp.get("quarantines") or 0
+        state = ("" if not q else
+                 "  RECOVERED" if dp.get("recovered") else "  UNRECOVERED")
+        lines.append(f"data plane: engine={dp.get('engine') or '-'} "
+                     f"stall_frac={_fmt(dp.get('stall_frac'), 3)}  "
+                     f"{dp['faults']} fault(s) ({dp['retried']} retried), "
+                     f"{q} quarantine(s)"
+                     + (f" shards={dp.get('quarantined_shards')}" if q else "")
+                     + state)
     soak = info.get("soak_report")
     if soak:
         verdict = "ok" if soak.get("ok") else "NOT ok"

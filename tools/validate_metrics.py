@@ -80,6 +80,12 @@ KNOWN_KINDS = frozenset({
     # chunked_stream) with prefetch stall accounting and the host shard-cache
     # watermark.
     "data_plane",
+    # Storage faults (data/sharded.py): data_fault is one read failure
+    # (transient_io / digest_mismatch), recovered=True when a retry served
+    # verified bytes; shard_quarantine marks a shard exhausted its retries —
+    # the pass either aborted (typed ShardReadError) or, under
+    # data.skip_quarantined, dropped the shard's rows from scoring.
+    "data_fault", "shard_quarantine",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -153,6 +159,11 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     # KEYS must be present so consumers can rely on the shape.
     "data_plane": ("stage", "engine", "prefetch_depth", "stall_s",
                    "stall_frac", "host_cache_bytes_in_use"),
+    # Storage-fault records. rank is null-tolerant (jax may not be
+    # initialized in the library code that classifies the failure).
+    "data_fault": ("split", "shard", "rank", "error_class", "retries",
+                   "recovered"),
+    "shard_quarantine": ("split", "shard", "rank", "error_class"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
