@@ -177,7 +177,7 @@ def _append_ledger(line: dict) -> None:
                   "exit_class", "chunk_steps", "mfu", "pass_s",
                   "score_stability", "slo", "serve", "comm", "run_id",
                   "data_plane", "prefetch_depth", "stall_frac", "overlap",
-                  "stall_s"):
+                  "stall_s", "autotune"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -351,6 +351,12 @@ def main() -> None:
                              "PERFORMANCE.md for the 2-process CPU recipe")
     parser.add_argument("--process-id", type=int, default=0)
     parser.add_argument("--coordinator", default="localhost:12399")
+    parser.add_argument("--autotune-combo", default=None,
+                        help="label this run as an autotune candidate: the "
+                             "metric is prefixed autotune.<name>. so each "
+                             "combo forms its own sentry comparison group, "
+                             "and an autotune={'combo': name} block rides "
+                             "the line + ledger record (tools/autotune.py)")
     parser.add_argument("--ledger", default=DEFAULT_LEDGER,
                         help="append-only perf-history JSONL every emitted "
                              "line lands in (tools/perf_sentry.py compares "
@@ -422,6 +428,12 @@ def main() -> None:
               "serve": serve_metric}[args.task]
     unit = {"northstar": "seconds", "serve": "ms"}.get(args.task,
                                                        "examples/sec/chip")
+    if args.autotune_combo:
+        # Candidate runs are their own per-combo metric (= their own sentry
+        # group): an autotune sweep must not pollute the headline trail, and
+        # a combo's own wins get defended combo-vs-combo-history.
+        metric = f"autotune.{args.autotune_combo}.{metric}"
+        _CAPTURE_DIAGNOSTICS["autotune"] = {"combo": args.autotune_combo}
 
     if not args.no_probe:
         info = probe_backend(args.probe_attempts, args.probe_timeout,

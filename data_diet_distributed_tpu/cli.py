@@ -150,6 +150,26 @@ def main(argv: list[str] | None = None) -> int:
     if monitor:
         monitor.start()
     logger = MetricsLogger(cfg.obs.metrics_path)
+    # Tuning manifest (tools/autotune.py output): applied HERE — after the
+    # backend is up (we key on backend/device_kind) but before _dispatch
+    # lazily imports the ops modules that read the env gates at import time.
+    # Explicit user config and pre-set env gates always win (tuning.py).
+    from .tuning import TuningError, maybe_apply_manifest
+    try:
+        import jax
+        try:
+            backend = jax.default_backend()
+            device_kind = jax.devices()[0].device_kind
+        except Exception:   # noqa: BLE001 — backend unusable: match loosely
+            backend = device_kind = None
+        decision = maybe_apply_manifest(cfg, backend=backend,
+                                        device_kind=device_kind)
+    except TuningError as err:
+        print(f"[tuning] {err}", file=sys.stderr, flush=True)
+        logger.close()
+        return 2
+    if decision is not None:
+        logger.log("tuning_applied", **decision)
     mono0 = time.perf_counter()
     try:
         rc = _supervised_body(cfg, command, logger, monitor, run_started,

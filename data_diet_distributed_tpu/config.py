@@ -676,6 +676,23 @@ class ObsConfig:
 
 
 @dataclass
+class TuningConfig:
+    """Autotuner manifest consumption (tools/autotune.py writes the manifest;
+    cli.py consults it at startup — see data_diet_distributed_tpu/tuning.py).
+
+    ``manifest`` is the path to a sha256-digest-signed ``tuning_manifest.json``
+    (null = the default ``artifacts/tuning_manifest.json`` if present).
+    ``apply`` picks the stale-manifest policy: ``auto`` applies a matching
+    manifest and skips a mismatched one with a logged reason, ``off`` never
+    reads the manifest, ``strict`` turns every skip (missing file, geometry or
+    backend mismatch) into a loud startup error. Explicit user config and
+    already-set env gates always win over manifest knobs, in every mode."""
+
+    manifest: str | None = None
+    apply: str = "auto"
+
+
+@dataclass
 class Config:
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -690,8 +707,13 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    tuning: TuningConfig = field(default_factory=TuningConfig)
 
     def validate(self) -> "Config":
+        if self.tuning.apply not in ("auto", "off", "strict"):
+            raise ValueError(
+                f"tuning.apply must be auto | off | strict, got "
+                f"{self.tuning.apply!r}")
         if self.data.dataset not in ("cifar10", "cifar100", "synthetic",
                                      "synthetic_imagenet", "npz", "sharded"):
             raise ValueError(f"unknown dataset {self.data.dataset!r}")
@@ -1039,6 +1061,7 @@ _TYPE_MAP = {
     "ParallelConfig": ParallelConfig, "CheckpointConfig": CheckpointConfig,
     "ObsConfig": ObsConfig, "ResilienceConfig": ResilienceConfig,
     "ElasticConfig": ElasticConfig, "ServeConfig": ServeConfig,
+    "TuningConfig": TuningConfig,
 }
 
 

@@ -343,6 +343,9 @@ class ServeFleet:
         self._last_load = (0, 0)   # (accepted, rejected) at last stats tick
         # Supervisor self-monitoring: threads already reported dead.
         self._dead_threads: set[str] = set()
+        # Tuning roll: how long a freshly rolled replica gets to answer
+        # /healthz before the roll aborts (generous: the child recompiles).
+        self.tuning_roll_wait_s = max(60.0, float(sv.canary_timeout_s) * 2)
 
     # ------------------------------------------------------------- records
 
@@ -809,6 +812,100 @@ class ServeFleet:
             if code == 200:
                 installed = newest
 
+    def _tuning_watch_loop(self) -> None:
+        """Fleet-wide tuning-manifest deployment: watch the signed manifest's
+        digest and roll replicas ONE AT A TIME when it changes. Replicas
+        re-apply the manifest themselves at boot (the CLI startup hook runs
+        in every child), so a roll is a sequential budget-free respawn; a
+        replica that does not come back healthy aborts the roll and the
+        remaining replicas keep serving the old configuration."""
+        from ..tuning import (DEFAULT_MANIFEST_PATH, TuningError,
+                              read_tuning_manifest)
+        poll = float(self.cfg.serve.refresh_poll_s)
+        path = self.cfg.tuning.manifest or DEFAULT_MANIFEST_PATH
+        last_reject: str | None = None
+
+        def digest_of() -> str | None:
+            nonlocal last_reject
+            if not os.path.exists(path):
+                return None
+            try:
+                return read_tuning_manifest(path).get("digest")
+            except TuningError as err:
+                # Once per distinct failure, not once per poll: a corrupt
+                # manifest sits there until an operator acts.
+                if str(err) != last_reject:
+                    last_reject = str(err)
+                    self._event("tuning_manifest_rejected", manifest=path,
+                                error=str(err))
+                return None
+
+        # A manifest present at fleet boot was already applied by every
+        # replica's own startup hook — only a CHANGE rolls the fleet.
+        installed = digest_of()
+        attempted: set[str] = set()
+        while not self._stop.wait(poll):
+            digest = digest_of()
+            if digest is None or digest == installed or digest in attempted:
+                continue
+            attempted.add(digest)   # one shot per digest, like refresh steps
+            if self._tuning_roll(path, digest):
+                installed = digest
+
+    def _tuning_roll(self, path: str, digest: str) -> bool:
+        self._event("tuning_roll", manifest=path, digest=digest)
+        with self._lock:
+            indices = [r.index for r in self.replicas if not r.retired]
+        for index in indices:
+            if self._stop.is_set():
+                return False
+            if not self._roll_replica_for_tuning(index):
+                self._event("tuning_roll_abort", replica=index,
+                            digest=digest)
+                return False
+        self._event("tuning_roll_complete", digest=digest)
+        return True
+
+    def _roll_replica_for_tuning(self, index: int) -> bool:
+        """Respawn one slot on the new manifest (budget-free, like growth)
+        and wait for its /healthz before the roll may touch the next slot.
+        Returns False when the fresh generation never answers — the abort
+        signal that keeps a bad manifest from taking the whole fleet."""
+        with self._lock:
+            if (self._stop.is_set() or self.replicas[index].retired
+                    or index in self._retiring):
+                return True   # nothing to roll — not a failure
+            proc = self.procs[index]
+            self.router.set_health(index, False)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=self.reap_timeout_s)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            fh = getattr(proc, "_ddt_log_fh", None)
+            if fh is not None:
+                fh.close()
+            self.gens[index] += 1
+            self.replicas[index].generation = self.gens[index]
+            # Fresh generation: its boot window is not a partition.
+            self._misses[index] = 0
+            self._seen_healthy[index] = False
+            self._probation.pop(index, None)
+            self.procs[index] = self._spawn(index, self.gens[index])
+            self._replica_event(index, "tuning_respawn",
+                                generation=self.gens[index],
+                                port=self.ports[index])
+        deadline = time.monotonic() + self.tuning_roll_wait_s
+        rep = self.replicas[index]
+        while time.monotonic() < deadline and not self._stop.is_set():
+            verdict = self._poll_health(rep)
+            if verdict is not None and verdict.get("status") != "critical":
+                return True
+            time.sleep(min(1.0, float(self.cfg.serve.health_poll_s)))
+        return False
+
     # ------------------------------------------------------------------ run
 
     def _on_signal(self, signum, frame) -> None:   # noqa: ARG002
@@ -843,6 +940,10 @@ class ServeFleet:
             self._threads.append(
                 threading.Thread(target=self._refresh_watch_loop,
                                  name="fleet-refresh", daemon=True))
+            if self.cfg.tuning.apply != "off":
+                self._threads.append(
+                    threading.Thread(target=self._tuning_watch_loop,
+                                     name="fleet-tuning", daemon=True))
         for t in self._threads:
             t.start()
         while not self._stop.is_set():

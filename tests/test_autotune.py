@@ -1,0 +1,336 @@
+"""Autotuner + signed tuning manifests (tools/autotune.py,
+data_diet_distributed_tpu/tuning.py, the cli.py startup hook).
+
+Pinned here: search-space enumeration honors recorded ledger negatives, an
+inexact candidate is disqualified loudly (both via an injected verifier and
+through the real subprocess child with the DDT_AUTOTUNE_FAKE_INEXACT hook),
+the manifest write/verify round-trip, digest-mismatch and geometry-mismatch
+refusal, the CLI applying a manifest on the CPU lane with env/user-config
+precedence, and validate_metrics accepting the new record kinds."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+import autotune  # noqa: E402
+import validate_metrics as vm  # noqa: E402
+
+from data_diet_distributed_tpu import tuning  # noqa: E402
+from data_diet_distributed_tpu.config import Config  # noqa: E402
+
+
+def _args(extra=()):
+    return autotune.build_parser().parse_args(
+        ["--task", "score", "--method", "grand", "--arch", "tiny_cnn",
+         "--dataset", "synthetic", "--size", "256", "--batch", "64",
+         *extra])
+
+
+def _combo_rec(combo, value, tail="grand_scoring_examples_per_sec_per_chip"):
+    return {"kind": "perf_history", "ts": 0.0, "source": "bench",
+            "metric": f"autotune.{combo}.{tail}", "value": value,
+            "unit": "examples/sec/chip", "exit_class": "ok"}
+
+
+def _manifest(**over):
+    fields = dict(task="score", method="grand", arch="tiny_cnn",
+                  dataset="synthetic", batch_size=64, backend="cpu",
+                  device_kind="cpu", n_devices=1,
+                  env={"DDT_GRAND_STEM_XLA": "1",
+                       "DDT_GRAND_MEGAKERNEL": "0"},
+                  config={"score.chunk_steps": 4, "data.prefetch_depth": 4},
+                  chosen_combo="stem_xla", metric="m", value=100.0,
+                  unit="examples/sec/chip", baseline_value=90.0,
+                  exactness=[{"combo": "stem_xla", "ok": True}],
+                  candidates_considered=3)
+    fields.update(over)
+    return tuning.build_tuning_manifest(**fields)
+
+
+def _cfg_for_manifest():
+    cfg = Config()
+    cfg.model.arch = "tiny_cnn"
+    cfg.data.dataset = "synthetic"
+    cfg.score.batch_size = 64
+    return cfg
+
+
+# ---------------------------------------------------------------- enumeration
+
+
+def test_enumeration_honors_ledger_negatives():
+    """A combo whose recorded per-combo trail lost to baseline's by more
+    than the threshold is pruned; baseline itself is never pruned."""
+    records = ([_combo_rec("baseline", 100.0) for _ in range(3)]
+               + [_combo_rec("megakernel", 70.0) for _ in range(3)]
+               + [_combo_rec("stem_xla", 105.0) for _ in range(3)])
+    neg = autotune.ledger_negatives(
+        records, "grand_scoring_examples_per_sec_per_chip", 0.10)
+    assert neg == {"megakernel"}
+    cands = autotune.enumerate_candidates(
+        _args(["--no-profile"]), records,
+        "grand_scoring_examples_per_sec_per_chip")
+    names = [c["name"] for c in cands]
+    assert "megakernel" not in names
+    assert "baseline" in names and "stem_xla" in names
+
+
+def test_ledger_negatives_never_prune_blind():
+    """No baseline trail -> nothing is pruned; capture-error records never
+    count as evidence."""
+    records = [_combo_rec("megakernel", 1.0)]
+    assert autotune.ledger_negatives(records, "grand_scoring_examples_per_sec_per_chip") == set()
+    bad = dict(_combo_rec("megakernel", 1.0), error="wedged")
+    records = [_combo_rec("baseline", 100.0)] * 3 + [bad] * 3
+    assert autotune.ledger_negatives(records, "grand_scoring_examples_per_sec_per_chip") == set()
+
+
+def test_explicit_combo_subset_and_unknown_refusal():
+    cands = autotune.enumerate_candidates(
+        _args(["--combos", "baseline,stem_xla"]), [],
+        "grand_scoring_examples_per_sec_per_chip")
+    assert [c["name"] for c in cands] == ["baseline", "stem_xla"]
+    with pytest.raises(SystemExit, match="unknown --combos"):
+        autotune.enumerate_candidates(
+            _args(["--combos", "nope"]), [],
+            "grand_scoring_examples_per_sec_per_chip")
+
+
+def test_default_enumeration_includes_fetch_arm():
+    cands = autotune.enumerate_candidates(
+        _args(["--no-profile"]), [],
+        "grand_scoring_examples_per_sec_per_chip")
+    byname = {c["name"]: c for c in cands}
+    assert byname["allgather_fetch"]["env"]["DDT_SCORE_FETCH"] == "allgather"
+    # Every bisect combo pins EVERY toggle (absent != off).
+    for cand in cands:
+        for knob in ("DDT_GRAND_MEGAKERNEL", "DDT_GRAND_STEM_XLA"):
+            assert knob in cand["env"], cand["name"]
+
+
+# ------------------------------------------------------------- disqualification
+
+
+def test_injected_inexact_candidate_disqualified(tmp_path):
+    events = tmp_path / "events.jsonl"
+    cand = {"name": "megakernel", "env": {}, "extra": []}
+    report = autotune.verify_candidate(
+        _args(), cand, str(events),
+        runner=lambda c: {"ok": False, "max_abs_err": 0.5})
+    assert report["ok"] is False
+    recs = [json.loads(ln) for ln in events.read_text().splitlines()]
+    assert recs[-1]["event"] == "disqualified"
+    assert recs[-1]["combo"] == "megakernel"
+
+
+@pytest.mark.slow
+def test_fake_inexact_hook_disqualifies_through_subprocess(tmp_path):
+    """The real verify child, env-poisoned via DDT_AUTOTUNE_FAKE_INEXACT:
+    the production scoring path diverges from the vmap reference and the
+    candidate is disqualified through the actual subprocess plumbing."""
+    events = tmp_path / "events.jsonl"
+    cand = {"name": "baseline",
+            "env": {"DDT_AUTOTUNE_FAKE_INEXACT": "1"}, "extra": []}
+    args = _args(["--verify-batch", "4", "--grand-chunk", "2",
+                  "--timeout", "240"])
+    report = autotune.verify_candidate(args, cand, str(events))
+    assert report["ok"] is False
+    assert report.get("max_abs_err", 1.0) > 2e-4
+    recs = [json.loads(ln) for ln in events.read_text().splitlines()]
+    assert recs[-1]["event"] == "disqualified"
+
+
+# ------------------------------------------------------------------- manifest
+
+
+def test_manifest_roundtrip(tmp_path):
+    path = str(tmp_path / "tuning_manifest.json")
+    manifest = _manifest()
+    tuning.write_tuning_manifest(path, manifest)
+    back = tuning.read_tuning_manifest(path)
+    assert back == manifest
+    assert back["digest"] == tuning.manifest_digest(back)
+
+
+def test_digest_mismatch_refused(tmp_path):
+    path = str(tmp_path / "tuning_manifest.json")
+    manifest = _manifest()
+    tuning.write_tuning_manifest(path, manifest)
+    doc = json.loads(Path(path).read_text())
+    doc["value"] = 99999.0   # tampered after signing
+    Path(path).write_text(json.dumps(doc))
+    with pytest.raises(tuning.TuningError, match="digest mismatch"):
+        tuning.read_tuning_manifest(path)
+    Path(path).write_text("{not json")
+    with pytest.raises(tuning.TuningError, match="corrupt"):
+        tuning.read_tuning_manifest(path)
+
+
+def test_unsigned_or_unknown_knob_manifest_refused(tmp_path):
+    manifest = _manifest()
+    manifest["digest"] = "0" * 64
+    with pytest.raises(tuning.TuningError, match="refusing to write"):
+        tuning.write_tuning_manifest(str(tmp_path / "m.json"), manifest)
+    with pytest.raises(tuning.TuningError, match="allowed set"):
+        _manifest(env={"LD_PRELOAD": "evil.so"})
+    with pytest.raises(tuning.TuningError, match="allowed set"):
+        _manifest(config={"optim.lr": 99.0})
+
+
+def test_geometry_mismatch_skipped_auto_refused_strict(tmp_path):
+    path = str(tmp_path / "tuning_manifest.json")
+    tuning.write_tuning_manifest(path, _manifest(arch="resnet18"))
+    cfg = _cfg_for_manifest()
+    cfg.tuning.manifest = path
+    decision = tuning.maybe_apply_manifest(cfg, backend="cpu",
+                                           device_kind="cpu", environ={})
+    assert decision["applied"] is False
+    assert "arch mismatch" in decision["reason"]
+    cfg.tuning.apply = "strict"
+    with pytest.raises(tuning.TuningError, match="arch mismatch"):
+        tuning.maybe_apply_manifest(cfg, backend="cpu", device_kind="cpu",
+                                    environ={})
+
+
+def test_backend_mismatch_and_missing_manifest(tmp_path):
+    path = str(tmp_path / "tuning_manifest.json")
+    tuning.write_tuning_manifest(path, _manifest(backend="tpu",
+                                                 device_kind="TPU v4"))
+    cfg = _cfg_for_manifest()
+    cfg.tuning.manifest = path
+    decision = tuning.maybe_apply_manifest(cfg, backend="cpu",
+                                           device_kind="cpu", environ={})
+    assert decision["applied"] is False
+    assert "backend mismatch" in decision["reason"]
+    # Missing explicit manifest: auto records the skip, strict refuses,
+    # an absent DEFAULT path is silent (the common untuned case).
+    cfg.tuning.manifest = str(tmp_path / "nope.json")
+    decision = tuning.maybe_apply_manifest(cfg, environ={})
+    assert decision == {"applied": False, "mode": "auto",
+                        "manifest": cfg.tuning.manifest,
+                        "reason": "manifest-missing"}
+    cfg.tuning.apply = "strict"
+    with pytest.raises(tuning.TuningError, match="does not exist"):
+        tuning.maybe_apply_manifest(cfg, environ={})
+    cfg.tuning.apply = "auto"
+    cfg.tuning.manifest = None
+    cwd = os.getcwd()
+    os.chdir(tmp_path)   # no artifacts/tuning_manifest.json here
+    try:
+        assert tuning.maybe_apply_manifest(cfg, environ={}) is None
+    finally:
+        os.chdir(cwd)
+    cfg.tuning.apply = "off"
+    assert tuning.maybe_apply_manifest(cfg, environ={}) is None
+
+
+def test_apply_precedence_env_and_user_config(tmp_path):
+    """Explicit user decisions ALWAYS win: a pre-set env gate and a config
+    knob changed from its dataclass default are skipped with named reasons;
+    untouched knobs are applied (env into the environ mapping, config onto
+    the cfg tree)."""
+    path = str(tmp_path / "tuning_manifest.json")
+    tuning.write_tuning_manifest(path, _manifest())
+    cfg = _cfg_for_manifest()
+    cfg.tuning.manifest = path
+    cfg.data.prefetch_depth = 7          # user-set (default is 2)
+    environ = {"DDT_GRAND_STEM_XLA": "0"}   # user-set gate
+    decision = tuning.maybe_apply_manifest(cfg, backend="cpu",
+                                           device_kind="cpu",
+                                           environ=environ)
+    assert decision["applied"] is True
+    assert decision["skipped"] == {"DDT_GRAND_STEM_XLA": "env",
+                                   "data.prefetch_depth": "user-config"}
+    assert environ["DDT_GRAND_STEM_XLA"] == "0"          # untouched
+    assert environ["DDT_GRAND_MEGAKERNEL"] == "0"        # applied
+    assert cfg.data.prefetch_depth == 7                  # untouched
+    assert cfg.score.chunk_steps == 4                    # applied
+    assert decision["knobs"]["score.chunk_steps"] == 4
+
+
+# ------------------------------------------------------------------ CLI lane
+
+
+def _run_cli(tmp_path, manifest_path, *, overrides=(), env=None,
+             metrics="metrics.jsonl"):
+    metrics_path = str(tmp_path / metrics)
+    cmd = [sys.executable, "-m", "data_diet_distributed_tpu.cli", "score",
+           "model.arch=tiny_cnn", "data.dataset=synthetic",
+           "data.synthetic_size=128", "data.batch_size=64",
+           "score.batch_size=64", "score.method=grand",
+           f"tuning.manifest={manifest_path}",
+           f"obs.metrics_path={metrics_path}",
+           f"train.checkpoint_dir={tmp_path / 'ckpt'}", *overrides]
+    full_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": str(REPO), **(env or {})}
+    out = subprocess.run(cmd, cwd=str(tmp_path), env=full_env,
+                         capture_output=True, text=True, timeout=300)
+    records = []
+    if os.path.exists(metrics_path):
+        with open(metrics_path) as fh:
+            records = [json.loads(ln) for ln in fh if ln.strip()]
+    return out, records
+
+
+def test_cli_applies_manifest_with_precedence(tmp_path):
+    """Acceptance pin: a real CPU-lane cli run logs a VALIDATED
+    tuning_applied record showing the manifest's knobs in effect, with a
+    pre-set env gate and an explicit user override skipped by name."""
+    path = str(tmp_path / "tuning_manifest.json")
+    tuning.write_tuning_manifest(path, _manifest())
+    out, records = _run_cli(
+        tmp_path, path,
+        overrides=["data.prefetch_depth=7"],
+        env={"DDT_GRAND_STEM_XLA": "0"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    applied = [r for r in records if r.get("kind") == "tuning_applied"]
+    assert len(applied) == 1
+    rec = applied[0]
+    assert rec["applied"] is True and rec["mode"] == "auto"
+    assert rec["knobs"]["DDT_GRAND_MEGAKERNEL"] == "0"
+    assert rec["knobs"]["score.chunk_steps"] == 4
+    assert rec["skipped"] == {"DDT_GRAND_STEM_XLA": "env",
+                              "data.prefetch_depth": "user-config"}
+    assert vm.validate_lines([json.dumps(r) for r in records],
+                             where="metrics") == []
+
+
+def test_cli_refuses_corrupted_digest(tmp_path):
+    """Acceptance pin: a corrupted-digest manifest is refused LOUDLY — the
+    run exits nonzero naming the mismatch instead of starting untuned."""
+    path = str(tmp_path / "tuning_manifest.json")
+    tuning.write_tuning_manifest(path, _manifest())
+    doc = json.loads(Path(path).read_text())
+    doc["env"]["DDT_GRAND_MEGAKERNEL"] = "1"   # tamper post-signing
+    Path(path).write_text(json.dumps(doc))
+    out, records = _run_cli(tmp_path, path)
+    assert out.returncode == 2
+    assert "digest mismatch" in out.stderr
+    assert not [r for r in records if r.get("kind") == "tuning_applied"]
+
+
+# ------------------------------------------------------------------ validator
+
+
+def test_validate_metrics_knows_tuning_kinds():
+    lines = [
+        json.dumps({"ts": 1.0, "kind": "autotune_event",
+                    "event": "measured", "combo": "stem_xla",
+                    "value": 100.0}),
+        json.dumps({"ts": 2.0, "kind": "tuning_applied", "applied": True,
+                    "mode": "auto", "manifest": "m.json",
+                    "knobs": {}, "skipped": {}}),
+    ]
+    assert vm.validate_lines(lines, where="t") == []
+    # Required fields enforced: a tuning_applied without its decision
+    # triple is a violation.
+    bad = [json.dumps({"ts": 3.0, "kind": "tuning_applied"})]
+    assert any("applied" in p for p in vm.validate_lines(bad, where="t"))

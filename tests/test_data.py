@@ -463,7 +463,7 @@ def test_make_shards_roundtrip_and_verify(tmp_path, capsys):
     rc = make_shards.main([str(src), "--out", str(out), "--shard-size", "32"])
     assert rc == 0
     summary = json.loads(capsys.readouterr().out)
-    assert summary["splits"]["train"] == {"n": 100, "shards": 4,
+    assert summary["splits"]["train"] == {"n": 100, "shards": 4, "reused": 0,
                                           "image_dtype": "uint8"}
     assert summary["norm"] is True   # uint8 source records train stats
 

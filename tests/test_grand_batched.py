@@ -258,6 +258,36 @@ def test_fused_bwd_masked_rows_and_refusal():
                                    batch["label"], batch["mask"])
 
 
+def test_fused_stateless_bn_matches_vmap():
+    """BN with use_scale=False AND use_bias=False has no trainable params —
+    its fused-path contribution must be a well-shaped [B] zero, not a Python
+    scalar 0.0 (which used to surface as a trace-time custom_vjp cotangent
+    shape error). Pinned against the vmap(grad) reference like every other
+    fused-path case."""
+    from data_diet_distributed_tpu.ops.grand_batched import \
+        batched_grand_scores_fused
+
+    class StatelessBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, *, train: bool = False,
+                     capture_features: bool = False):
+            x = nn.Conv(8, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             use_scale=False, use_bias=False)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(jnp.mean(x, axis=(1, 2)))
+
+    model = StatelessBN()
+    batch = _batch(6, 16, seed=9)
+    variables = _trained_stats(model, _init(model, 16), batch)
+    fused = np.asarray(batched_grand_scores_fused(
+        model, variables, batch["image"], batch["label"], batch["mask"]))
+    ref = np.asarray(make_grand_step(model, chunk=3)(
+        variables, {k: jnp.asarray(v) for k, v in batch.items()}))
+    assert fused.shape == (6,) and np.isfinite(fused).all()
+    np.testing.assert_allclose(fused, ref, rtol=2e-4, atol=1e-5)
+
+
 def test_masked_rows_score_zero():
     model = create_model("tiny_cnn", 10)
     batch = _batch(8, 16, seed=1)

@@ -1108,6 +1108,61 @@ def test_dead_supervisor_thread_flips_healthz_critical(tmp_path):
     assert vm.validate_file(str(cfg.obs.metrics_path)) == []
 
 
+# ------------------------------------------------- tuning-manifest roll
+
+class _RollProc:
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return 0 if self.terminated else None
+
+    def terminate(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):   # noqa: ARG002
+        return 0
+
+    def kill(self):
+        self.terminated = True
+
+
+def test_tuning_roll_one_at_a_time_and_abort(tmp_path, monkeypatch):
+    cfg = _cfg(tmp_path, "serve.replicas=2", "serve.health_poll_s=0.05")
+    spawned = []
+
+    def spawn(index, gen):
+        spawned.append((index, gen))
+        return _RollProc()
+
+    fleet = ServeFleet(cfg, logger=None, spawn=spawn)
+    originals = [_RollProc() for _ in range(fleet.n)]
+    with fleet._lock:
+        for i, p in enumerate(originals):
+            fleet.procs[i] = p
+    # Healthy fleet: every fresh generation answers /healthz at once.
+    monkeypatch.setattr(fleet, "_poll_health", lambda rep: {"status": "ok"})
+    assert fleet._tuning_roll("m.json", "d1") is True
+    # Strictly sequential: each live slot respawned exactly once on gen+1,
+    # old process terminated before its successor spawns.
+    assert spawned == [(0, 1), (1, 1)]
+    assert all(p.terminated for p in originals)
+    assert fleet.gens == [1, 1]
+    assert [e["event"] for e in fleet.events] == ["tuning_roll",
+                                                 "tuning_roll_complete"]
+    # A replica that never comes back healthy aborts the roll: slot 0 is
+    # respawned and fails its wait; slot 1 is never touched.
+    spawned.clear()
+    fleet.events.clear()
+    fleet.tuning_roll_wait_s = 0.2
+    monkeypatch.setattr(fleet, "_poll_health", lambda rep: None)
+    assert fleet._tuning_roll("m.json", "d2") is False
+    assert spawned == [(0, 2)]
+    assert fleet.gens == [2, 1]
+    assert [e["event"] for e in fleet.events] == ["tuning_roll",
+                                                 "tuning_roll_abort"]
+
+
 # ------------------------------------------------- multi-endpoint client
 
 def _free_url():

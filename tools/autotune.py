@@ -1,0 +1,517 @@
+"""Self-driving promotion: search the gate/knob space, verify exactness,
+sign a tuning manifest.
+
+The repo's fast paths sit behind env gates (``DDT_GRAND_*``,
+``DDT_SHARDED_UPDATE``, ``DDT_SCORE_FETCH``) and config knobs
+(``score.chunk_steps``, ``train.chunk_steps``, prefetch depth) that have
+historically been promoted by a hand-run bisection. This tool composes the
+existing machinery into one command::
+
+    python tools/autotune.py --task score --method grand
+
+1. **Enumerate** candidates from the pinned ``bisect_grand.py`` combos
+   (``--full`` for the whole matrix), an `allgather` score-fetch arm, a
+   chunk arm seeded by ``profile_dispatch.py``'s difference-quotient
+   recommendation, and — under ``--data-plane streaming`` — prefetch-depth
+   arms. Combos whose recorded per-combo ledger trail regressed vs the
+   baseline combo's are pruned (negatives are remembered, not re-run).
+2. **Measure** each through ``bench.py`` (probe hardening, ``--deadline``,
+   ``--fresh-retries`` inherited); every sample lands in the perf ledger
+   under a per-combo metric (``autotune.<name>.<metric>``) so
+   ``perf_sentry.py`` defends each combo's own trail.
+3. **Verify**: the winning gated path is re-run in a child process (env
+   gates are read at import) and compared against the toggle-independent
+   ``grand_vmap`` reference with the repo's pinned tolerances. An inexact
+   candidate is disqualified LOUDLY and the next-best takes its place —
+   never a silent promotion.
+4. **Sign**: the winner becomes an atomic, sha256-digest-signed
+   ``artifacts/tuning_manifest.json`` (see
+   ``data_diet_distributed_tpu/tuning.py``) that ``cli.py`` applies at
+   startup and the serve fleet rolls out one replica at a time. A final
+   confirmation bench run (headline metric, no combo prefix) appends the
+   clean record the sentry judges.
+
+Every decision is also appended to ``artifacts/autotune_events.jsonl`` as
+``{"kind": "autotune_event"}`` records (validated by validate_metrics.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from bisect_grand import COMBOS, FAST, _ALL_OFF, _combo  # noqa: E402
+from perf_sentry import (CLEAN, DEFAULT_THRESHOLD, autotune_combo,  # noqa: E402
+                         classify_record, load_ledger, lower_is_better,
+                         median)
+
+from data_diet_distributed_tpu.tuning import (  # noqa: E402
+    DEFAULT_MANIFEST_PATH, TuningError, build_tuning_manifest,
+    write_tuning_manifest)
+
+BENCH = os.path.join(_REPO, "bench.py")
+DEFAULT_EVENTS = os.path.join(_REPO, "artifacts", "autotune_events.jsonl")
+
+#: Exactness pins — the same tolerances tests/test_grand_batched.py holds
+#: every gated path to against the vmap(grad) reference.
+RTOL, ATOL = 2e-4, 1e-5
+
+
+def _event(events_path: str | None, event: str, **fields) -> None:
+    """One autotune_event record: printed (the tool's progress stream IS its
+    log) and appended to the events JSONL for validate_metrics.py."""
+    rec = {"kind": "autotune_event", "ts": round(time.time(), 3),
+           "event": event, **fields}
+    print(f"[autotune] {json.dumps(rec)}", flush=True)
+    if events_path:
+        try:
+            from data_diet_distributed_tpu.utils.io import atomic_append_jsonl
+            atomic_append_jsonl(events_path, rec)
+        except Exception as exc:   # noqa: BLE001 — observability, best-effort
+            print(f"[autotune] event append failed: {exc!r}",
+                  file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# enumeration
+
+
+def ledger_negatives(records: list[dict], metric_tail: str,
+                     threshold: float = DEFAULT_THRESHOLD) -> set[str]:
+    """Combo names whose recorded per-combo trail already lost to the
+    baseline combo's — a negative the search must remember, not re-run.
+
+    Looks at clean ``autotune.<name>.<metric_tail>`` records; a combo with
+    a median worse than baseline's median by more than ``threshold`` is a
+    negative. No baseline trail → nothing is pruned (never prune blind)."""
+    by_combo: dict[str, list[dict]] = {}
+    for rec in records:
+        combo = autotune_combo(rec)
+        if (combo is not None and classify_record(rec) == CLEAN
+                and str(rec.get("metric", "")).endswith("." + metric_tail)):
+            by_combo.setdefault(combo, []).append(rec)
+    base = by_combo.get("baseline")
+    if not base:
+        return set()
+    base_med = median([float(r["value"]) for r in base])
+    lower = lower_is_better(base[0])
+    out = set()
+    for name, rs in by_combo.items():
+        if name == "baseline":
+            continue
+        m = median([float(r["value"]) for r in rs])
+        worse = (m > base_med * (1 + threshold) if lower
+                 else m < base_med * (1 - threshold))
+        if worse:
+            out.add(name)
+    return out
+
+
+def profile_chunk_recommendation(args) -> int | None:
+    """Seed the chunk arm from profile_dispatch.py's difference-quotient
+    recommendation (``recommended <label> >= N``). Best-effort: a profiler
+    failure skips the arm, it never fails the search."""
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "profile_dispatch.py"),
+           "--task", args.task, "--arch", args.arch, "--batch",
+           str(args.batch), "--size", str(args.size), "--reps", "1"]
+    if args.task == "score":
+        cmd += ["--method", args.method, "--grand-chunk",
+                str(args.grand_chunk)]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=args.timeout)
+        m = None
+        for line in out.stdout.splitlines():
+            m = re.search(r"recommended \S+ >= (\d+)", line) or m
+        return int(m.group(1)) if m else None
+    except Exception:   # noqa: BLE001
+        return None
+
+
+def enumerate_candidates(args, ledger_records: list[dict], metric_tail: str,
+                         events_path: str | None = None) -> list[dict]:
+    """The candidate list: ``{"name", "env", "extra"}`` per candidate.
+
+    Seeded by the pinned bisect combos (FAST unless ``--full`` /
+    ``--combos``), widened by the score-fetch arm, the profile-seeded chunk
+    arm, and (streaming lane only) prefetch-depth arms; pruned by recorded
+    ledger negatives."""
+    if args.combos:
+        wanted = [c.strip() for c in args.combos.split(",") if c.strip()]
+        combos = [c for c in COMBOS if c[0] in wanted]
+        missing = set(wanted) - {c[0] for c in combos}
+        if missing:
+            raise SystemExit(f"unknown --combos entries: {sorted(missing)} "
+                             f"(known: {[c[0] for c in COMBOS]})")
+    else:
+        combos = [c for c in COMBOS if args.full or c[0] in FAST]
+    cands = [{"name": n, "env": dict(e), "extra": list(x)}
+             for n, e, x in combos]
+    if args.task == "score" and not args.combos:
+        # The legacy fetch engine, pinned identical to stream by tests —
+        # still worth a timing arm on fabrics where the collective wins.
+        cands.append({"name": "allgather_fetch",
+                      "env": {**_combo(), "DDT_SCORE_FETCH": "allgather"},
+                      "extra": []})
+    if not args.no_profile and not args.combos:
+        rec = profile_chunk_recommendation(args)
+        if rec is not None and rec > 1:
+            _event(events_path, "profile_seed", chunk=rec)
+            cands.append({"name": f"profile_chunk{rec}",
+                          "env": _combo("STEM_XLA"),
+                          "extra": ["--chunk", str(rec)]})
+    if args.data_plane == "streaming" and not args.combos:
+        for depth in (0, 2, 4):
+            cands.append({"name": f"prefetch{depth}", "env": _combo(),
+                          "extra": ["--data-plane", "streaming",
+                                    "--prefetch-depth", str(depth)]})
+    negatives = ledger_negatives(ledger_records, metric_tail,
+                                 args.threshold)
+    kept = []
+    for cand in cands:
+        if cand["name"] in negatives and cand["name"] != "baseline":
+            _event(events_path, "pruned_negative", combo=cand["name"])
+            continue
+        kept.append(cand)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# measurement
+
+
+def _bench_cmd(args, cand: dict, *, combo_flag: bool) -> list[str]:
+    cmd = [sys.executable, BENCH, "--task", args.task,
+           "--method", args.method, "--arch", args.arch,
+           "--dataset", args.dataset, "--size", str(args.size),
+           "--batch", str(args.batch), "--grand-chunk",
+           str(args.grand_chunk), "--repeats", str(args.repeats),
+           "--ledger", args.ledger,
+           "--fresh-retries", str(args.fresh_retries)]
+    if args.deadline is not None:
+        cmd += ["--deadline", str(args.deadline)]
+    if args.no_probe:
+        cmd += ["--no-probe"]
+    if combo_flag:
+        cmd += ["--autotune-combo", cand["name"]]
+    return cmd + list(cand["extra"])
+
+
+def measure_candidate(args, cand: dict,
+                      events_path: str | None = None) -> dict:
+    """One bench run under the candidate's pinned env. Returns the bench's
+    JSON line (or an error dict); the ledger append happened inside bench."""
+    cmd = _bench_cmd(args, cand, combo_flag=True)
+    try:
+        out = subprocess.run(cmd, env={**os.environ, **cand["env"]},
+                             capture_output=True, text=True,
+                             timeout=args.timeout)
+        lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+        if not lines:
+            return {"error": (out.stderr or "no bench output")[-300:]}
+        try:
+            return json.loads(lines[-1])
+        except ValueError:
+            return {"error": f"unparseable bench output: {lines[-1][:300]}"}
+    except subprocess.TimeoutExpired:
+        return {"error": "TIMEOUT"}
+
+
+# ---------------------------------------------------------------------------
+# exactness verification
+
+
+def verify_candidate(args, cand: dict, events_path: str | None = None,
+                     runner=None) -> dict:
+    """Re-run this file in ``--verify-child`` mode under the candidate's env
+    (the gates are read at ops import) and compare the production scoring
+    path against the toggle-independent vmap(grad) reference at the pinned
+    tolerances. Returns the child's report dict; ``ok`` False disqualifies.
+
+    ``runner`` is injectable for tests (same signature as the default)."""
+    if runner is None:
+        def runner(cand):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--verify-child", "--arch", args.arch,
+                   "--method", args.method,
+                   "--verify-batch", str(args.verify_batch),
+                   "--grand-chunk", str(min(args.grand_chunk, 4))]
+            for extra_flag in ("--chunk",):
+                if extra_flag in cand["extra"]:
+                    i = cand["extra"].index(extra_flag)
+                    cmd += [extra_flag, cand["extra"][i + 1]]
+            out = subprocess.run(cmd, env={**os.environ, **cand["env"]},
+                                 capture_output=True, text=True,
+                                 timeout=args.timeout)
+            lines = [ln for ln in out.stdout.splitlines()
+                     if ln.startswith("{")]
+            if not lines:
+                return {"ok": False,
+                        "error": (out.stderr or "no output")[-300:]}
+            try:
+                return json.loads(lines[-1])
+            except ValueError:
+                return {"ok": False, "error": lines[-1][:300]}
+    report = runner(cand)
+    report.setdefault("combo", cand["name"])
+    if report.get("ok"):
+        _event(events_path, "verified", combo=cand["name"],
+               max_abs_err=report.get("max_abs_err"))
+    else:
+        # LOUD disqualification: an inexact fast path must never be
+        # recommended — this is the promotion gate, not a warning.
+        _event(events_path, "disqualified", combo=cand["name"],
+               error=report.get("error"),
+               max_abs_err=report.get("max_abs_err"))
+    return report
+
+
+def _verify_child(args) -> int:
+    """Runs WITH the candidate env already in place: imports the gated ops,
+    scores a deterministic synthetic batch through the production path, and
+    checks it against the vmap(grad) reference engine."""
+    import jax
+    import numpy as np
+
+    from data_diet_distributed_tpu.models import create_model
+    from data_diet_distributed_tpu.ops.scores import (make_grand_step,
+                                                      make_score_step)
+
+    b, hw = args.verify_batch, 16
+    rng = np.random.default_rng(0)
+    batch = {"image": rng.normal(size=(b, hw, hw, 3)).astype(np.float32),
+             "label": rng.integers(0, 10, b).astype(np.int32),
+             "index": np.arange(b, dtype=np.int32),
+             "mask": np.ones(b, np.float32)}
+    model = create_model(args.arch, 10)
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(0), np.zeros((1, hw, hw, 3), np.float32), train=False)
+
+    step = (make_score_step(model, args.method) if args.chunk is None else
+            make_score_step(model, args.method, chunk=args.chunk))
+    scores = np.asarray(step(variables, batch))
+    if os.environ.get("DDT_AUTOTUNE_FAKE_INEXACT"):
+        scores = scores + 0.01   # test hook: simulate a wrong fast path
+    report = {"backend": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind,
+              "n_devices": jax.device_count()}
+    if args.method in ("grand", "grand_vmap"):
+        ref = np.asarray(
+            make_grand_step(model, chunk=max(2, min(args.grand_chunk, b)))(
+                variables, batch))
+        err = np.abs(scores - ref)
+        denom = np.maximum(np.abs(ref), 1e-12)
+        report["max_abs_err"] = float(err.max())
+        report["ok"] = bool(np.all(err <= ATOL + RTOL * np.abs(ref))
+                            and np.isfinite(scores).all())
+        report["max_rel_err"] = float((err / denom).max())
+        report["rtol"], report["atol"] = RTOL, ATOL
+        report["reference"] = "grand_vmap"
+    else:
+        # Non-grand methods have no env-gated fast path to diverge; pin
+        # finiteness + shape so a broken candidate still fails loudly.
+        report["ok"] = bool(np.isfinite(scores).all()
+                            and scores.shape == (b,))
+        report["reference"] = "finite-check"
+    print(json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+# ---------------------------------------------------------------------------
+# manifest assembly
+
+
+def _manifest_config_knobs(args, cand: dict) -> dict:
+    cfg: dict = {}
+    extra = list(cand["extra"])
+    if "--chunk" in extra:
+        chunk = int(extra[extra.index("--chunk") + 1])
+        key = "score.chunk_steps" if args.task == "score" else \
+            "train.chunk_steps"
+        cfg[key] = chunk
+    if "--prefetch-depth" in extra:
+        cfg["data.prefetch_depth"] = int(
+            extra[extra.index("--prefetch-depth") + 1])
+    if "--data-plane" in extra:
+        cfg["data.data_plane"] = extra[extra.index("--data-plane") + 1]
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--task", default="score", choices=["score", "train"])
+    ap.add_argument("--method", default="grand")
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--dataset", default="synthetic")
+    ap.add_argument("--size", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--grand-chunk", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--data-plane", default="auto")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="forwarded to every bench run")
+    ap.add_argument("--fresh-retries", type=int, default=1,
+                    help="forwarded to every bench run")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="forwarded to every bench run (CPU lane)")
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="per-subprocess wall cap (bench / profile / verify)")
+    ap.add_argument("--full", action="store_true",
+                    help="the whole bisect matrix, not the curated FAST race")
+    ap.add_argument("--combos", default=None,
+                    help="comma-separated explicit bisect-combo subset "
+                         "(disables the fetch/profile/prefetch arms)")
+    ap.add_argument("--no-profile", action="store_true",
+                    help="skip the profile_dispatch-seeded chunk arm")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="ledger-negative pruning threshold")
+    ap.add_argument("--ledger", default=os.path.join(
+        _REPO, "artifacts", "perf_history.jsonl"))
+    ap.add_argument("--events", default=DEFAULT_EVENTS,
+                    help="autotune_event JSONL sink ('' disables)")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, DEFAULT_MANIFEST_PATH))
+    ap.add_argument("--no-confirm", action="store_true",
+                    help="skip the final headline-metric confirmation bench")
+    # internal: exactness child (env gates already pinned by the parent)
+    ap.add_argument("--verify-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--verify-batch", type=int, default=8,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--chunk", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.verify_child:
+        return _verify_child(args)
+    events = args.events or None
+    metric_tail = (f"{args.method}_scoring_examples_per_sec_per_chip"
+                   if args.task == "score" else
+                   "train_examples_per_sec_per_chip")
+    ledger_records = (load_ledger(args.ledger)
+                      if os.path.exists(args.ledger) else [])
+    cands = enumerate_candidates(args, ledger_records, metric_tail, events)
+    if not any(c["name"] == "baseline" for c in cands):
+        # The all-off program is the search's reference point AND the
+        # guaranteed-exact fallback; it is never pruned away.
+        cands.insert(0, {"name": "baseline", "env": _combo(), "extra": []})
+    _event(events, "search_start", task=args.task, method=args.method,
+           arch=args.arch, dataset=args.dataset, size=args.size,
+           batch=args.batch, candidates=[c["name"] for c in cands])
+
+    results = []
+    for cand in cands:
+        line = measure_candidate(args, cand, events)
+        rec = {**cand, "result": line}
+        results.append(rec)
+        err = line.get("error")
+        value = line.get("value")
+        _event(events, "measured", combo=cand["name"], value=value,
+               unit=line.get("unit"), error=err)
+        if err and "backend" in str(err):
+            # Same abort rule as bisect_grand: a dead relay fails every
+            # combo identically — one bounded failure is the signal.
+            _event(events, "abort_backend", combo=cand["name"], error=err)
+            return 2
+
+    clean = [r for r in results
+             if not r["result"].get("error")
+             and (r["result"].get("value") or 0) > 0
+             and r["result"].get("exit_class", "ok") == "ok"]
+    if not clean:
+        _event(events, "no_clean_candidates")
+        return 2
+    lower = str(clean[0]["result"].get("unit", "")).lower() in (
+        "seconds", "s", "ms")
+    ranked = sorted(clean, key=lambda r: r["result"]["value"],
+                    reverse=not lower)
+
+    winner, exactness = None, []
+    for cand in ranked:
+        report = verify_candidate(args, cand, events)
+        exactness.append({k: report.get(k) for k in
+                          ("combo", "ok", "reference", "max_abs_err",
+                           "max_rel_err", "rtol", "atol")})
+        if report.get("ok"):
+            winner = {**cand, "verify": report}
+            break
+    if winner is None:
+        _event(events, "no_exact_candidate")
+        return 2
+    _event(events, "winner", combo=winner["name"],
+           value=winner["result"]["value"],
+           unit=winner["result"].get("unit"))
+
+    baseline = next((r for r in results if r["name"] == "baseline"), None)
+    baseline_value = (baseline["result"].get("value")
+                      if baseline and not baseline["result"].get("error")
+                      else None)
+    manifest = build_tuning_manifest(
+        task=args.task, method=args.method, arch=args.arch,
+        dataset=args.dataset, batch_size=args.batch,
+        backend=winner["verify"].get("backend", "unknown"),
+        device_kind=winner["verify"].get("device_kind", "unknown"),
+        n_devices=int(winner["verify"].get("n_devices", 1)),
+        env=winner["env"], config=_manifest_config_knobs(args, winner),
+        chosen_combo=winner["name"],
+        metric=str(winner["result"].get("metric", metric_tail)),
+        value=float(winner["result"]["value"]),
+        unit=str(winner["result"].get("unit", "")),
+        baseline_value=baseline_value, exactness=exactness,
+        candidates_considered=len(results))
+    write_tuning_manifest(args.out, manifest)
+    _event(events, "manifest_written", path=args.out,
+           digest=manifest["digest"], combo=winner["name"])
+
+    if not args.no_confirm:
+        # The headline-metric confirmation: the tuned point's clean record
+        # lands LAST in the ledger, so perf_sentry judges the promoted
+        # configuration (and defends it next round).
+        confirm_cmd = _bench_cmd(args, winner, combo_flag=False)
+        try:
+            out = subprocess.run(confirm_cmd,
+                                 env={**os.environ, **winner["env"]},
+                                 capture_output=True, text=True,
+                                 timeout=args.timeout)
+            lines = [ln for ln in out.stdout.splitlines()
+                     if ln.startswith("{")]
+            line = json.loads(lines[-1]) if lines else {
+                "error": (out.stderr or "no bench output")[-300:]}
+        except subprocess.TimeoutExpired:
+            line = {"error": "TIMEOUT"}
+        _event(events, "confirmed", combo=winner["name"],
+               value=line.get("value"), error=line.get("error"))
+        if line.get("error"):
+            print("[autotune] confirmation run failed — manifest stands, "
+                  "but the headline trail gained no clean record",
+                  file=sys.stderr, flush=True)
+            return 3
+    print(json.dumps({"manifest": args.out, "digest": manifest["digest"],
+                      "chosen_combo": winner["name"],
+                      "value": winner["result"]["value"],
+                      "baseline_value": baseline_value}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except TuningError as err:
+        print(f"[autotune] {err}", file=sys.stderr, flush=True)
+        raise SystemExit(2)

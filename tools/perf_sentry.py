@@ -115,10 +115,31 @@ def load_ledger(path: str) -> list[dict]:
     return records
 
 
-def _median(values: list[float]) -> float:
+def median(values: list[float]) -> float:
+    """Plain median (shared with tools/autotune.py's ledger-negative
+    pruning — the same statistic the sentry baselines on)."""
     s = sorted(values)
     n = len(s)
     return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+_median = median
+
+
+def autotune_combo(rec: dict) -> str | None:
+    """The autotune combo name when ``rec`` is a candidate sample
+    (``autotune.<name>.<metric>`` or the bench's ``autotune`` rider),
+    else None. Lets ledger consumers separate sweep samples from the
+    headline trail without re-parsing metric strings."""
+    rider = rec.get("autotune")
+    if isinstance(rider, dict) and rider.get("combo"):
+        return str(rider["combo"])
+    metric = str(rec.get("metric", ""))
+    if metric.startswith("autotune."):
+        rest = metric[len("autotune."):]
+        if "." in rest:
+            return rest.split(".", 1)[0]
+    return None
 
 
 def check_group(records: list[dict], *, threshold: float,

@@ -86,6 +86,13 @@ KNOWN_KINDS = frozenset({
     # the pass either aborted (typed ShardReadError) or, under
     # data.skip_quarantined, dropped the shard's rows from scoring.
     "data_fault", "shard_quarantine",
+    # Autotuner (tools/autotune.py + data_diet_distributed_tpu/tuning.py):
+    # autotune_event is the search's decision stream (search_start /
+    # pruned_negative / measured / verified / disqualified / winner /
+    # manifest_written / confirmed); tuning_applied is the CLI's startup
+    # verdict on the signed manifest (applied or skipped, with reason,
+    # knobs, and the precedence-skipped set).
+    "autotune_event", "tuning_applied",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -164,6 +171,11 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     "data_fault": ("split", "shard", "rank", "error_class", "retries",
                    "recovered"),
     "shard_quarantine": ("split", "shard", "rank", "error_class"),
+    # Autotuner records. Null-tolerant like elastic_event: per-event
+    # payloads (combo, value, digest) ride as optional fields; the
+    # tuning_applied verdict always carries the decision triple.
+    "autotune_event": ("event",),
+    "tuning_applied": ("applied", "mode", "manifest"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
