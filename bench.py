@@ -177,7 +177,7 @@ def _append_ledger(line: dict) -> None:
                   "exit_class", "chunk_steps", "mfu", "pass_s",
                   "score_stability", "slo", "serve", "comm", "run_id",
                   "data_plane", "prefetch_depth", "stall_frac", "overlap",
-                  "stall_s", "autotune"):
+                  "stall_s", "autotune", "phases"):
             if line.get(k) is not None:
                 rec[k] = line[k]
         if "jax" in sys.modules:   # error lines can precede backend init
@@ -1017,7 +1017,10 @@ def bench_serve_fleet(args, metric: str) -> None:
              achieved_rps=report["achieved_rps"],
              router_retries=router["retries"],
              router_replays=router["replays"],
-             router_hedges=router["hedges"])
+             router_hedges=router["hedges"],
+             phases={p: {"p50_ms": s.get("p50"), "p95_ms": s.get("p95")}
+                     for p, s in (router.get("phases") or {}).items()},
+             slowest=report.get("slowest"))
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -1086,6 +1089,12 @@ def bench_serve(args, metric: str) -> None:
             raise RuntimeError(
                 f"serve load window completed no requests: {report}")
         stats = service.stats_record()
+        # Per-phase breakdown (request observatory): where the request
+        # latency lives — queue vs coalesce vs dispatch vs fetch — so the
+        # ledger trail lets perf_sentry catch a regression in ONE phase
+        # even when total p95 stays within its threshold.
+        phases = {p: {"p50_ms": s.get("p50"), "p95_ms": s.get("p95")}
+                  for p, s in (stats.get("phases") or {}).items()}
         emit(metric, round(report["p95_ms"], 3), "ms",
              round(SERVE_BUDGET_P95_MS / report["p95_ms"], 4),
              p50_ms=report["p50_ms"], max_ms=report["max_ms"],
@@ -1095,7 +1104,8 @@ def bench_serve(args, metric: str) -> None:
              offered_rps=report["offered_rps"],
              achieved_rps=report["achieved_rps"],
              dispatches=stats["dispatches"], batch_fill=stats["batch_fill"],
-             serve_batch=engine.batch_size)
+             serve_batch=engine.batch_size, phases=phases,
+             slowest=report.get("slowest"))
     finally:
         service.stop()
 

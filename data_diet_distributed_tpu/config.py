@@ -475,6 +475,19 @@ class ServeConfig:
     # Canary-hold wall bound; zero routed traffic inside it is judged
     # inconclusive and the roll proceeds (recorded as such).
     canary_timeout_s: float = 30.0
+    # --- request tracing (obs/reqtrace.py) ------------------------------
+    # Head-sampling fraction for HEALTHY traffic's {"kind":"serve_trace"}
+    # records. The keep/drop decision hashes the trace id, so the router
+    # and every replica independently reach the same answer for the same
+    # request (no coordination header needed on the happy path). Failed,
+    # slow, retried, hedged, and replayed requests are ALWAYS kept
+    # regardless of this knob (tail-biased retention). 0.0 = tail only,
+    # 1.0 = every request.
+    trace_sample_frac: float = 0.02
+    # Wall-time threshold (ms) past which a request counts as "slow" and
+    # its trace is always kept. None -> obs.slo_serve_p95_ms when that
+    # SLO is armed, else 250 ms.
+    trace_slow_ms: float | None = None
 
 
 @dataclass
@@ -1034,6 +1047,13 @@ class Config:
         if sv.canary_timeout_s <= 0:
             raise ValueError(f"serve.canary_timeout_s must be > 0, got "
                              f"{sv.canary_timeout_s}")
+        if not 0.0 <= sv.trace_sample_frac <= 1.0:
+            raise ValueError(f"serve.trace_sample_frac must be in [0, 1], "
+                             f"got {sv.trace_sample_frac}")
+        if sv.trace_slow_ms is not None and sv.trace_slow_ms <= 0:
+            raise ValueError(
+                f"serve.trace_slow_ms must be > 0 (or null to follow "
+                f"obs.slo_serve_p95_ms), got {sv.trace_slow_ms}")
         return self
 
 

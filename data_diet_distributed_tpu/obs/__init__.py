@@ -1,5 +1,5 @@
-from . import (fleet, flightrec, heartbeat, lineage, registry, scoreboard,
-               server, slo, timeline, tracing, xla)
+from . import (fleet, flightrec, heartbeat, lineage, registry, reqtrace,
+               scoreboard, server, slo, timeline, tracing, xla)
 from .fleet import FleetMonitor, fleet_view
 from .flightrec import FlightRecorder
 from .heartbeat import Heartbeat
@@ -24,4 +24,4 @@ __all__ = ["MetricsLogger", "ResourceMonitor", "sample_devices", "StepTimer",
            "heartbeat", "flightrec", "xla", "XlaIntrospector", "HbmMonitor",
            "ProfileWindow", "scoreboard", "Scoreboard",
            "server", "StatusServer", "fleet", "FleetMonitor", "fleet_view",
-           "slo", "SloEngine", "lineage", "timeline"]
+           "slo", "SloEngine", "lineage", "timeline", "reqtrace"]

@@ -389,18 +389,27 @@ class SloEngine:
 
     def check_serve(self, *, point, p95_ms: float | None = None,
                     queue_depth: int | None = None,
-                    reject_frac: float | None = None, logger=None) -> None:
+                    reject_frac: float | None = None, logger=None,
+                    phases: dict | None = None) -> None:
         """Serving-contract evaluation, once per serve_stats point: p95
         request latency vs ``slo_serve_p95_ms``, pending queue depth vs
         ``slo_serve_queue_depth``, and the run-so-far rejected fraction vs
         ``slo_serve_reject_frac``. ``point`` is the stats sequence number —
         a sustained breach re-records at each new point (a sustained
-        collapse is a sustained fact), never twice for the same one."""
+        collapse is a sustained fact), never twice for the same one.
+        ``phases`` (the reqtrace per-phase summary) lets a p95 violation
+        NAME the phase whose live p95 is largest — the record carries its
+        own first-cut attribution."""
         if (self.serve_p95_ms is not None and p95_ms is not None
                 and p95_ms > self.serve_p95_ms):
+            ctx = {}
+            if phases:
+                dom = max(phases, key=lambda p: phases[p].get("p95") or 0.0)
+                ctx = {"dominant_phase": dom,
+                       "dominant_phase_p95_ms": phases[dom].get("p95")}
             self._violate("serve_p95", round(float(p95_ms), 3),
                           self.serve_p95_ms, logger=logger,
-                          point=("serve_p95", point))
+                          point=("serve_p95", point), **ctx)
         if (self.serve_queue_depth is not None and queue_depth is not None
                 and queue_depth > self.serve_queue_depth):
             self._violate("serve_queue_depth", int(queue_depth),

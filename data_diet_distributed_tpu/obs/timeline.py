@@ -33,6 +33,7 @@ import os
 
 from . import flightrec as obs_flightrec
 from . import heartbeat as obs_heartbeat
+from . import reqtrace as obs_reqtrace
 from . import tracing as obs_tracing
 
 __all__ = ["read_records", "discover_artifacts", "build_timeline",
@@ -447,14 +448,21 @@ def merge_perfetto(traces: list[dict], out_path: str,
     ``attempt<k>/rank<r>``), and the metrics stream's fault / elastic /
     resume records become instant markers on the matching attempt's rank-0
     lane — the flame chart and the fault story in one viewer. Returns
-    ``{"events", "lanes"}`` counts."""
+    ``serve_trace`` records additionally stitch into one lane PER REQUEST
+    (keyed by trace id): the router's admission/routing/proxy spans and
+    each replica's queue/coalesce/dispatch/fetch/serialize spans, from
+    whichever processes emitted them, laid out on the request's own wall
+    interval — with hedged / retried / replayed / failed requests marked
+    in the lane name and as instant events. Returns
+    ``{"events", "lanes", "request_lanes"}`` counts."""
     merged: list[dict] = []
     lane_of: dict[tuple[int, int], int] = {}
+    req_lane_of: dict[str, int] = {}
 
     def lane(attempt: int, rank: int) -> int:
         key = (int(attempt or 0), int(rank or 0))
         if key not in lane_of:
-            pid = len(lane_of)
+            pid = 1_000_000 + len(lane_of)
             lane_of[key] = pid
             merged.append({"ph": "M", "name": "process_name", "pid": pid,
                            "tid": 0,
@@ -493,9 +501,85 @@ def merge_perfetto(traces: list[dict], out_path: str,
                      if k not in ("kind", "ts")
                      and isinstance(v, (str, int, float, bool))},
         })
+    _merge_request_lanes(merged, req_lane_of, records or [])
     d = os.path.dirname(os.path.abspath(out_path))
     if d:
         os.makedirs(d, exist_ok=True)
     with open(out_path, "w") as fh:
         json.dump(merged, fh)
-    return {"events": len(merged), "lanes": len(lane_of)}
+    return {"events": len(merged), "lanes": len(lane_of),
+            "request_lanes": len(req_lane_of)}
+
+
+def _merge_request_lanes(merged: list[dict], req_lane_of: dict[str, int],
+                         records: list[dict]) -> None:
+    """Stitch every kept ``serve_trace`` record into one Perfetto lane per
+    trace id: the router's spans on tid 0, each replica's on its own tid,
+    laid sequentially over the record's own wall interval (emission ``ts``
+    minus ``wall_ms``). Hedged / retried / replayed / failed requests are
+    marked both in the lane name and as instant events, so the tail is
+    findable by eye in a fleet-sized merge."""
+    by_trace: dict[str, list[dict]] = {}
+    for rec in records:
+        if rec.get("kind") != "serve_trace":
+            continue
+        tid = rec.get("trace_id")
+        if isinstance(tid, str) and isinstance(rec.get("ts"), (int, float)):
+            by_trace.setdefault(tid, []).append(rec)
+    for n, (trace_id, recs) in enumerate(sorted(by_trace.items())):
+        pid = 2_000_000 + n
+        req_lane_of[trace_id] = pid
+        marks = sorted({m for r in recs for m in (
+            ("hedged",) if r.get("hedged") else ())
+            + (("retried",) if r.get("retries") else ())
+            + (("replay",) if r.get("replay") else ())
+            + (("failed",) if (r.get("status") or 0) >= 400 else ())})
+        suffix = f" [{','.join(marks)}]" if marks else ""
+        merged.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": f"request {trace_id[:12]}{suffix}"}})
+        merged.append({"ph": "M", "name": "process_sort_index", "pid": pid,
+                       "tid": 0, "args": {"sort_index": pid}})
+        for rec in sorted(recs, key=lambda r: 0 if r.get("where") == "router"
+                          else 1):
+            where = rec.get("where") or "?"
+            tid = 0 if where == "router" else 1 + int(rec.get("replica") or 0)
+            tname = where if where == "router" \
+                else f"replica{rec.get('replica')}"
+            merged.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+            wall_ms = float(rec.get("wall_ms") or 0.0)
+            cursor_us = (rec["ts"] - wall_ms / 1e3) * 1e6
+            order = obs_reqtrace.ROUTER_PHASES if where == "router" \
+                else obs_reqtrace.REPLICA_PHASES
+            phases = rec.get("phases") or {}
+            for phase in order:
+                ms = phases.get(phase)
+                if not ms:
+                    continue
+                merged.append({"ph": "X", "name": phase, "cat": "serve_trace",
+                               "ts": round(cursor_us, 1),
+                               "dur": round(float(ms) * 1e3, 1),
+                               "pid": pid, "tid": tid,
+                               "args": {"trace_id": trace_id,
+                                        "status": rec.get("status"),
+                                        "replica": rec.get("replica")}})
+                cursor_us += float(ms) * 1e3
+            for mark in marks if where == "router" else ():
+                merged.append({"ph": "i", "s": "p", "name": mark,
+                               "cat": "serve_trace",
+                               "ts": round(rec["ts"] * 1e6, 1),
+                               "pid": pid, "tid": tid,
+                               "args": {"trace_id": trace_id}})
+            for a in rec.get("attempts") or []:
+                if not isinstance(a, dict):
+                    continue
+                merged.append({"ph": "i", "s": "t",
+                               "name": f"attempt:replica{a.get('replica')}:"
+                                       f"{a.get('outcome')}",
+                               "cat": "serve_trace",
+                               "ts": round(rec["ts"] * 1e6, 1),
+                               "pid": pid, "tid": tid,
+                               "args": {k: v for k, v in a.items()
+                                        if isinstance(v, (str, int, float,
+                                                          bool))}})

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
+from ..obs import reqtrace as obs_reqtrace
 from ..resilience import inject
 
 
@@ -67,6 +68,13 @@ class _Request:
     remaining: int = 0      # rows whose scores are still pending
     error: Exception | None = None
     wall_s: float | None = None
+    # --- request-tracing seam (obs/reqtrace) ---------------------------
+    trace: object | None = None      # RequestTrace the HTTP layer emits
+    taken_ts: float | None = None    # monotonic first-taken-into-a-dispatch
+    window_expired: bool = False     # first dispatch departed partial
+    dispatch_ms: float = 0.0         # program execution (accumulated)
+    fetch_ms: float = 0.0            # device_get of the scores
+    cold: bool = False               # any dispatch paid a compile
 
     def __post_init__(self):
         self.scores = np.zeros(len(self.images), np.float32)
@@ -148,10 +156,12 @@ class ScoreBatcher:
     # ------------------------------------------------------------- submit
 
     def submit(self, tenant: str, method: str, images, labels, *,
-               timeout_s: float = 60.0) -> np.ndarray:
+               timeout_s: float = 60.0, trace=None) -> np.ndarray:
         """Enqueue and wait; returns ``scores[n]``. Raises ``Backpressure``
         (queue full), ``Draining`` (shutdown), ``TimeoutError``, or the
-        dispatch's own failure."""
+        dispatch's own failure. ``trace`` (a ``reqtrace.RequestTrace``) is
+        filled in place with the queue/coalesce/dispatch/fetch phase
+        breakdown; the caller owns emission."""
         images = np.asarray(images, np.float32)
         labels = np.asarray(labels, np.int32)
         if len(images) != len(labels):
@@ -159,7 +169,7 @@ class ScoreBatcher:
         if len(images) == 0:
             return np.zeros(0, np.float32)
         req = _Request(tenant=tenant, method=method, images=images,
-                       labels=labels, enqueued=time.monotonic())
+                       labels=labels, enqueued=time.monotonic(), trace=trace)
         with self._cv:
             if not self._admitting:
                 raise Draining("service is draining; admission stopped")
@@ -281,6 +291,15 @@ class ScoreBatcher:
                 if r.taken == len(r.images):
                     q.popleft()
                     self._inflight += 1
+            # Span boundary for tracing: the first time a request's rows
+            # are taken ends its wait. A PARTIAL departure means the wait
+            # was (at least partly) the coalescing window's doing; a full
+            # batch never waited on the window, only on queue service.
+            partial = took < self.batch_size
+            for r, _, _ in parts:
+                if r.taken_ts is None:
+                    r.taken_ts = now
+                    r.window_expired = partial
             return name, method, parts
         return best_wait
 
@@ -316,8 +335,21 @@ class ScoreBatcher:
         except Exception as exc:   # noqa: BLE001 — the requester gets the failure
             scores, error = None, exc
         finally:
+            started = self._dispatch_started
             self._dispatch_started = None
         now = time.monotonic()
+        # Phase evidence for tracing: the engine's dispatch/fetch split
+        # when it offers one, else the whole dispatch wall as "dispatch"
+        # (fake engines in tests, failed dispatches).
+        info = getattr(self.engine, "last_dispatch_info", None)
+        if info is not None and error is None:
+            disp_ms = float(info.get("dispatch_ms", 0.0)) \
+                + float(info.get("compile_ms", 0.0))
+            fetch_ms = float(info.get("fetch_ms", 0.0))
+            cold = bool(info.get("cold", False))
+        else:
+            disp_ms = (now - started) * 1e3 if started is not None else 0.0
+            fetch_ms, cold = 0.0, False
         done: list[_Request] = []
         with self._cv:
             self.dispatches += 1
@@ -329,6 +361,12 @@ class ScoreBatcher:
                     r.error = error
                 else:
                     r.scores[o:o + n] = scores[pos:pos + n]
+                # Every rider waited for the whole dispatch (scores fan
+                # out only after it lands), so each gets the full phase
+                # cost; split requests accumulate across dispatches.
+                r.dispatch_ms += disp_ms
+                r.fetch_ms += fetch_ms
+                r.cold = r.cold or cold
                 pos += n
                 r.remaining -= n
                 if r.remaining == 0:
@@ -344,14 +382,36 @@ class ScoreBatcher:
                     else:
                         self.failed += 1
             self._cv.notify_all()
+        fill = round(len(images) / self.batch_size, 4)
         for r in done:
             obs_registry.observe("serve_request_ms", r.wall_s * 1e3)
+            phases = self._request_phases(r)
+            obs_reqtrace.observe_phases(phases)
+            if r.trace is not None:
+                for name, ms in phases.items():
+                    r.trace.add_ms(name, ms)
+                r.trace.cold = r.trace.cold or r.cold
+                r.trace.batch_fill = fill
             if self.request_log and self.logger is not None:
                 rec = dict(tenant=r.tenant, method=r.method,
                            n=len(r.images), wall_ms=round(r.wall_s * 1e3, 3),
-                           batch_fill=round(len(images) / self.batch_size,
-                                            4))
+                           batch_fill=fill)
                 if r.error is not None:
                     rec["error"] = repr(r.error)[:200]
                 self.logger.log("serve_request", **rec)
             r.done.set()
+
+    def _request_phases(self, r: _Request) -> dict[str, float]:
+        """Decompose one completed request's wait into the traced phases.
+
+        ``queue_wait``/``coalesce_wait`` split the enqueue->first-taken
+        span: a request whose first dispatch departed window-expired
+        (partial batch) charges up to ``window_s`` of that span to the
+        coalescing window, the rest to queue service; a full-batch
+        departure never waited on the window, so it is all queue."""
+        wait_ms = max(0.0, ((r.taken_ts if r.taken_ts is not None
+                             else r.enqueued) - r.enqueued) * 1e3)
+        coalesce = min(wait_ms, self.window_s * 1e3) if r.window_expired \
+            else 0.0
+        return {"queue_wait": wait_ms - coalesce, "coalesce_wait": coalesce,
+                "dispatch": r.dispatch_ms, "fetch": r.fetch_ms}

@@ -96,6 +96,9 @@ class ServeEngine:
         # the AOT-compiled executable (strong ref) + serving stats.
         self._programs: dict[tuple, dict] = {}
         self._lock = threading.Lock()
+        # Phase timing of the most recent score_batch, read by the
+        # batcher's single dispatcher thread (the only hot-path caller).
+        self.last_dispatch_info: dict | None = None
 
     # ------------------------------------------------------ composable units
 
@@ -319,14 +322,32 @@ class ServeEngine:
                                      np.asarray(labels, np.int32))
             entry = self._ensure_program(method, chunk_fn,
                                          (t.variables_seeds[0], *ops))
+            cold = entry["dispatches"] == 0
             total = np.zeros(n, np.float64)
             t0 = time.perf_counter()
+            # Split the wall honestly for tracing: chunk_fn returns when
+            # the program is enqueued (dispatch), device_get blocks until
+            # the scores land on the host (fetch = wait + transfer).
+            dispatch_s = fetch_s = 0.0
             for variables in t.variables_seeds:
+                td = time.perf_counter()
                 out = chunk_fn(variables, *ops)
+                tf = time.perf_counter()
                 total += np.asarray(jax.device_get(out), np.float64)[0, :n]
+                now = time.perf_counter()
+                dispatch_s += tf - td
+                fetch_s += now - tf
             entry["dispatches"] += len(t.variables_seeds)
             obs_registry.observe("serve_dispatch_s",
                                  time.perf_counter() - t0)
+            # Read by the batcher's single dispatcher thread right after
+            # this call returns (the only hot-path caller), so a plain
+            # attribute is race-free.
+            self.last_dispatch_info = {
+                "cold": cold, "dispatch_ms": dispatch_s * 1e3,
+                "fetch_ms": fetch_s * 1e3,
+                "compile_ms": entry["compile_s"] * 1e3 if cold else 0.0,
+            }
         return (total / len(t.variables_seeds)).astype(np.float32)
 
     def full_scores(self, tenant: str, method: str) -> np.ndarray:

@@ -86,6 +86,7 @@ import urllib.error
 import urllib.request
 
 from ..obs import lineage
+from ..obs import reqtrace
 from ..obs.slo import SloEngine
 from ..resilience.elastic import (EXIT_PREEMPTED, JsonlLogger, RestartBudget,
                                   classify_rc, free_port)
@@ -304,7 +305,11 @@ class ServeFleet:
             canary_timeout_s=float(sv.canary_timeout_s),
             # The canary's floors ARE the fleet SLOs (obs/slo.judge_canary).
             canary_p95_floor_ms=cfg.obs.slo_fleet_p95_ms,
-            canary_error_frac=cfg.obs.slo_serve_reject_frac)
+            canary_error_frac=cfg.obs.slo_serve_reject_frac,
+            # Request tracing: same sampling fraction and slow threshold
+            # the replicas resolve, so both edges keep/drop in agreement.
+            trace_sample_frac=sv.trace_sample_frac,
+            trace_slow_ms=reqtrace.slow_threshold_ms(cfg))
         self.procs: list = [None] * self.n
         self.gens = [0] * self.n
         self.events: list[dict] = []

@@ -45,6 +45,10 @@ import time
 from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# jax-free on purpose (supervisor process): reqtrace touches only the obs
+# registry/logger, never the accelerator.
+from ..obs import reqtrace as obs_reqtrace
+
 #: Transport-level failures: the request may not have reached the replica
 #: (or its answer died with it). These — and only these — count against the
 #: breaker and are retry-eligible. HTTP error STATUSES (429, 400, 409…) are
@@ -238,7 +242,9 @@ class ServeRouter:
                  canary_requests: int | None = None,
                  canary_timeout_s: float = 30.0,
                  canary_p95_floor_ms: float | None = None,
-                 canary_error_frac: float | None = None):
+                 canary_error_frac: float | None = None,
+                 trace_sample_frac: float = 0.0,
+                 trace_slow_ms: float = obs_reqtrace.DEFAULT_SLOW_MS):
         self.replicas = list(replicas)
         self.host = host
         self.port = int(port)
@@ -247,6 +253,11 @@ class ServeRouter:
         self.timeout_s = float(timeout_s)
         self.retry_after_s = float(retry_after_s)
         self.logger = logger
+        # Request-tracing retention at the router edge (obs/reqtrace):
+        # 0.0 = tail-only (failed/slow/retried/hedged/replayed requests
+        # still always keep their serve_trace record).
+        self.trace_sample_frac = float(trace_sample_frac)
+        self.trace_slow_ms = float(trace_slow_ms)
         # Refresh-roll delegate: fleet injects its own roll (which knows the
         # replica generation map); None = the router's built-in roll.
         self.on_refresh = on_refresh
@@ -376,14 +387,16 @@ class ServeRouter:
             conns.append(conn)
         try:
             fwd = {k: v for k, v in headers.items()
-                   if k.lower() in ("content-type", "idempotency-key")}
+                   if k.lower() in ("content-type", "idempotency-key",
+                                    "x-trace-id", "x-trace-keep")}
             if body and "content-type" not in {k.lower() for k in fwd}:
                 fwd["Content-Type"] = "application/json"
             conn.request(method, path, body=body or None, headers=fwd)
             resp = conn.getresponse()
             data = resp.read()
             out_headers = {}
-            for key in ("Content-Type", "Retry-After"):
+            for key in ("Content-Type", "Retry-After",
+                        obs_reqtrace.TRACE_HEADER):
                 val = resp.getheader(key)
                 if val is not None:
                     out_headers[key] = val
@@ -454,13 +467,44 @@ class ServeRouter:
 
     # --------------------------------------------------------------- routing
 
+    def _emit_trace(self, trace_id: str, *, status: int, wall_ms: float,
+                    phases: dict, replay: bool = False, retries: int = 0,
+                    hedged: bool = False, **fields) -> None:
+        """Router-side ``serve_trace`` with the tail-biased retention
+        policy: failed/slow requests and any request the router had to
+        work for (retry, hedge, replay) always keep their record; healthy
+        traffic head-samples by the trace-id hash — the same answer every
+        replica computes for the same id."""
+        obs_reqtrace.observe_phases(phases)
+        failed = status >= 400
+        slow = wall_ms >= self.trace_slow_ms
+        flagged = replay or hedged or retries > 0
+        if not obs_reqtrace.should_keep(trace_id, self.trace_sample_frac,
+                                        failed=failed, slow=slow,
+                                        flagged=flagged):
+            return
+        obs_reqtrace.emit(self.logger, trace_id=trace_id, where="router",
+                          status=status, wall_ms=wall_ms, phases=phases,
+                          sampled=not (failed or slow or flagged),
+                          replay=replay, retries=retries, hedged=hedged,
+                          **fields)
+
     def handle(self, method: str, path: str, body: bytes,
                headers: dict) -> tuple[int, bytes | dict, dict]:
         """Route one client request; returns (status, body, headers)."""
         self._count("requests")
+        t_in = time.monotonic()
+        # Trace identity: accept the client's id or mint at this edge; it
+        # rides every hop (_proxy_once forwards it) and every response.
+        trace_id = next((v for k, v in headers.items()
+                         if k.lower() == "x-trace-id"), None)
+        if trace_id is None:
+            trace_id = obs_reqtrace.mint_trace_id()
+            headers = dict(headers, **{obs_reqtrace.TRACE_HEADER: trace_id})
+        techo = {obs_reqtrace.TRACE_HEADER: trace_id}
         if self._draining:
-            return 503, {"error": "router draining"}, {
-                "Retry-After": f"{self.retry_after_s:g}"}
+            return 503, {"error": "router draining"}, dict(
+                techo, **{"Retry-After": f"{self.retry_after_s:g}"})
         idem_key = next((v for k, v in headers.items()
                          if k.lower() == "idempotency-key"), None)
         idempotent = method == "GET" or idem_key is not None
@@ -473,15 +517,22 @@ class ServeRouter:
                 if entry.event.wait(timeout=budget) and entry.result:
                     status, data, hdrs = entry.result
                     self._count("replays")
-                    return status, data, dict(hdrs, **echo,
+                    wall_ms = (time.monotonic() - t_in) * 1e3
+                    self._emit_trace(trace_id, status=status,
+                                     wall_ms=wall_ms, replay=True,
+                                     phases={"admission": wall_ms,
+                                             "routing": 0.0, "proxy": 0.0},
+                                     path=path, replica=None)
+                    return status, data, dict(hdrs, **echo, **techo,
                                               **{"X-Idempotent-Replay": "1"})
                 # Original owner failed (or timed out): dispatch ourselves,
                 # publishing into the same entry on success.
         t0 = time.monotonic()
         deadline = t0 + self.timeout_s
+        attempts: list[dict] = []
         try:
             result = self._dispatch(method, path, body, headers, idempotent,
-                                    deadline)
+                                    deadline, attempts=attempts)
         except BaseException:
             if entry is not None:
                 self._idem_publish(idem_key, entry, None)
@@ -494,10 +545,37 @@ class ServeRouter:
         if entry is not None:
             self._idem_publish(idem_key, entry,
                                (status, data, hdrs) if status == 200 else None)
-        return status, data, dict(hdrs, **echo)
+        # Phase decomposition: admission is everything before routing
+        # started (drain gate + idempotency rendezvous), proxy is the
+        # WINNING attempt's wire time, and routing is the remainder —
+        # candidate selection, failed attempts, hedge wait. Failovers
+        # therefore show up as routing time, annotated per attempt.
+        wall_ms = (time.monotonic() - t_in) * 1e3
+        admission_ms = (t0 - t_in) * 1e3
+        win = next((a for a in attempts if a.get("outcome") == "ok"
+                    and (rep is None or a.get("replica") == rep.index)),
+                   None)
+        proxy_ms = float(win["ms"]) if win else 0.0
+        self._emit_trace(
+            trace_id, status=status, wall_ms=wall_ms,
+            phases={"admission": admission_ms, "proxy": proxy_ms,
+                    "routing": max(0.0, wall_ms - admission_ms - proxy_ms)},
+            retries=sum(1 for a in attempts if a.get("outcome") != "ok"),
+            hedged=any(a.get("hedge") for a in attempts),
+            path=path, replica=rep.index if rep is not None else None,
+            attempts=[{"replica": a.get("replica"),
+                       "outcome": a.get("outcome"),
+                       "hedge": bool(a.get("hedge")),
+                       "ms": round(float(a.get("ms") or 0.0), 3)}
+                      for a in attempts])
+        return status, data, dict(hdrs, **echo, **techo)
 
-    def _dispatch(self, method, path, body, headers, idempotent, deadline):
-        """(status, body, headers, replica-or-None) after retry/hedge."""
+    def _dispatch(self, method, path, body, headers, idempotent, deadline,
+                  attempts: list | None = None):
+        """(status, body, headers, replica-or-None) after retry/hedge.
+        ``attempts`` (when given) collects one
+        ``{"replica", "outcome", "hedge", "ms"}`` row per attempt — the
+        trace's failover evidence."""
         attempted: set[int] = set()
         last_exc: BaseException | None = None
         budget_tries = (self.retries + 1) if idempotent else 1
@@ -509,7 +587,7 @@ class ServeRouter:
             if (self.hedge_ms is not None and idempotent and len(reps) >= 2
                     and tried == 0):
                 result = self._hedged(reps, method, path, body, headers,
-                                      deadline, attempted)
+                                      deadline, attempted, attempts)
                 if result is not None:
                     return result
                 tried += 2
@@ -519,6 +597,7 @@ class ServeRouter:
             if rep is None:
                 break
             tried += 1
+            t_att = time.monotonic()
             try:
                 status, data, hdrs = self._proxy_once(
                     rep, method, path, body, headers, deadline)
@@ -526,8 +605,17 @@ class ServeRouter:
                 last_exc = exc
                 self._note_failure(rep, exc)
                 attempted.add(rep.index)
+                if attempts is not None:
+                    attempts.append({
+                        "replica": rep.index, "outcome": "transport_error",
+                        "ms": (time.monotonic() - t_att) * 1e3})
                 if idempotent:
                     self._count("retries")
+                    # The request just became tail-interesting: hint every
+                    # later hop to keep its trace record so the failover
+                    # lane stitches end to end.
+                    headers = dict(headers,
+                                   **{obs_reqtrace.KEEP_HEADER: "1"})
                     continue
                 return 502, {"error": "upstream transport failure on a "
                                       "non-idempotent request (no "
@@ -535,6 +623,9 @@ class ServeRouter:
                              "detail": repr(exc)[:200]}, {}, None
             self._note_success(rep)
             self._count("proxied")
+            if attempts is not None:
+                attempts.append({"replica": rep.index, "outcome": "ok",
+                                 "ms": (time.monotonic() - t_att) * 1e3})
             return status, data, hdrs, rep
         if last_exc is not None and time.monotonic() >= deadline:
             return 504, {"error": "deadline exhausted retrying",
@@ -544,7 +635,8 @@ class ServeRouter:
                      "detail": (repr(last_exc)[:200] if last_exc else None)}, \
             {"Retry-After": f"{self.retry_after_s:g}"}, None
 
-    def _hedged(self, reps, method, path, body, headers, deadline, attempted):
+    def _hedged(self, reps, method, path, body, headers, deadline, attempted,
+                attempts: list | None = None):
         """Primary + one hedge: first success wins, the loser's connection
         is closed (its blocked read tears down, the thread exits). Returns
         the winning (status, body, headers, replica) or None when both
@@ -562,20 +654,37 @@ class ServeRouter:
                     if state["finished"] >= state["launched"]:
                         done.set()
                 return
+            # The hedge leg marks the request tail-interesting — hint the
+            # replica to keep its trace record (the primary is already in
+            # flight without the hint; the interesting answer is usually
+            # the hedge's anyway).
+            hd = dict(headers, **{obs_reqtrace.KEEP_HEADER: "1"}) \
+                if is_hedge else headers
+            t_att = time.monotonic()
             try:
                 status, data, hdrs = self._proxy_once(
-                    rep, method, path, body, headers, deadline,
+                    rep, method, path, body, hd, deadline,
                     conns=all_conns[rep.index])
             except TRANSPORT_ERRORS as exc:
                 self._note_failure(rep, exc)
                 with lock:
                     attempted.add(rep.index)
+                    if attempts is not None:
+                        attempts.append({
+                            "replica": rep.index, "hedge": is_hedge,
+                            "outcome": "transport_error",
+                            "ms": (time.monotonic() - t_att) * 1e3})
                     state["finished"] += 1
                     if state["finished"] >= state["launched"]:
                         done.set()
                 return
             self._note_success(rep)
             with lock:
+                if attempts is not None:
+                    attempts.append({
+                        "replica": rep.index, "hedge": is_hedge,
+                        "outcome": "ok",
+                        "ms": (time.monotonic() - t_att) * 1e3})
                 state["finished"] += 1
                 if state["result"] is None:
                     state["result"] = (status, data, hdrs, rep, is_hedge)
@@ -801,7 +910,8 @@ class ServeRouter:
         return {**counters, "available": self.available(),
                 "replicas": len(self.active_replicas()),
                 "p50_ms": round(percentile(lat, 0.50), 3),
-                "p95_ms": round(percentile(lat, 0.95), 3)}
+                "p95_ms": round(percentile(lat, 0.95), 3),
+                "phases": obs_reqtrace.phase_summary()}
 
     def status(self) -> dict:
         return {"router": self.stats(),

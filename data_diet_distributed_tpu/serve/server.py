@@ -38,6 +38,7 @@ import numpy as np
 from ..config import Config
 from ..obs import heartbeat as obs_heartbeat
 from ..obs import registry as obs_registry
+from ..obs import reqtrace as obs_reqtrace
 from ..obs import server as obs_server
 from ..obs import slo as obs_slo
 from ..resilience.preemption import Preempted, PreemptionHandler
@@ -104,6 +105,15 @@ class _ServeHandler(obs_server._Handler):
         owner = self.server.owner   # type: ignore[attr-defined]
         t0 = time.perf_counter()
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        # Trace identity: accept the upstream hop's id (router or client),
+        # mint at this edge otherwise; every response echoes it back. The
+        # keep header is the router's retention hint for an already-
+        # interesting request (retry/hedge in flight).
+        trace_id = (self.headers.get(obs_reqtrace.TRACE_HEADER)
+                    or obs_reqtrace.mint_trace_id())
+        trace = obs_reqtrace.RequestTrace(
+            trace_id,
+            keep_hint=self.headers.get(obs_reqtrace.KEEP_HEADER) == "1")
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -112,14 +122,16 @@ class _ServeHandler(obs_server._Handler):
         except (ValueError, OSError) as exc:
             self._respond(400, json.dumps(
                 {"error": f"bad request body: {exc}"[:300]}).encode(),
-                "application/json")
+                "application/json",
+                {obs_reqtrace.TRACE_HEADER: trace_id})
             owner._note_request(time.perf_counter() - t0)
             return
         try:
             service = owner.service
             with service.http_inflight():
                 if path == "/v1/score":
-                    code, payload, headers = service.handle_score(body)
+                    code, payload, headers = service.handle_score(
+                        body, trace=trace)
                 elif path == "/v1/rank":
                     code, payload, headers = service.handle_rank(body)
                 elif path == "/v1/refresh":
@@ -135,9 +147,16 @@ class _ServeHandler(obs_server._Handler):
         idem = self.headers.get("Idempotency-Key")
         if idem:
             headers = dict(headers, **{"Idempotency-Key": idem})
+        headers = dict(headers, **{obs_reqtrace.TRACE_HEADER: trace_id})
+        t_ser = time.perf_counter()
         self._respond(code, json.dumps(payload).encode(), "application/json",
                       headers)
+        trace.add_ms("serialize", (time.perf_counter() - t_ser) * 1e3)
         owner._note_request(time.perf_counter() - t0)
+        owner.service.emit_trace(trace, status=code, path=path,
+                                 tenant=body.get("tenant"),
+                                 method=body.get("method"),
+                                 wall_ms=(time.perf_counter() - t0) * 1e3)
 
     def _stream_topk(self, owner) -> None:
         service = owner.service
@@ -162,6 +181,9 @@ class _ServeHandler(obs_server._Handler):
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("X-Serve-Tenant", tenant)
             self.send_header("X-Serve-Method", method)
+            self.send_header(obs_reqtrace.TRACE_HEADER,
+                             self.headers.get(obs_reqtrace.TRACE_HEADER)
+                             or obs_reqtrace.mint_trace_id())
             # Body-until-close framing: the item count is not known to be
             # small, and buffering it whole would defeat the streaming
             # contract ([N] never materializes as one response body).
@@ -261,6 +283,10 @@ class ServeService:
         # every stats record so a shared metrics stream attributes lines.
         rep = os.environ.get("DDT_SERVE_REPLICA")
         self.replica = int(rep) if rep is not None else None
+        # Request-tracing retention policy (obs/reqtrace): deterministic
+        # head-sampling for healthy traffic, always-keep for the tail.
+        self.trace_frac = float(sv.trace_sample_frac)
+        self.trace_slow_ms = obs_reqtrace.slow_threshold_ms(cfg)
         self._watch_stop = threading.Event()
         self._watch_thread: threading.Thread | None = None
 
@@ -358,7 +384,7 @@ class ServeService:
 
     # ------------------------------------------------------------ handlers
 
-    def handle_score(self, body: dict) -> tuple[int, dict, dict]:
+    def handle_score(self, body: dict, trace=None) -> tuple[int, dict, dict]:
         tenant = body.get("tenant") or self.default_tenant
         method = body.get("method") or self.default_method
         try:
@@ -376,7 +402,7 @@ class ServeService:
                                       "examples) or \"images\"+\"labels\""}, {}
             scores = self.batcher.submit(
                 tenant, method, images, labels,
-                timeout_s=self.cfg.serve.request_timeout_s)
+                timeout_s=self.cfg.serve.request_timeout_s, trace=trace)
         except Backpressure as exc:
             return (429, {"error": str(exc),
                           "retry_after_s": exc.retry_after_s},
@@ -508,6 +534,34 @@ class ServeService:
         self.engine.full_scores(tenant, method)
         return tenant, method, self.engine.topk(tenant, method, k)
 
+    # ------------------------------------------------------ request tracing
+
+    def emit_trace(self, trace, *, status: int, wall_ms: float,
+                   path: str, tenant: str | None,
+                   method: str | None) -> None:
+        """Replica-side ``serve_trace`` emission with tail-biased
+        retention: failed (>=400), slow (past the resolved threshold), or
+        hop-flagged (``X-Trace-Keep``) requests always keep their record;
+        healthy traffic head-samples by hashing the trace id. The
+        serialize phase feeds its live histogram either way (the batcher
+        already observed queue/coalesce/dispatch/fetch)."""
+        ser = trace.phases.get("serialize")
+        if ser is not None:
+            obs_reqtrace.observe_phases({"serialize": ser})
+        failed = status >= 400
+        slow = wall_ms >= self.trace_slow_ms
+        if not obs_reqtrace.should_keep(trace.trace_id, self.trace_frac,
+                                        failed=failed, slow=slow,
+                                        flagged=trace.keep_hint):
+            return
+        obs_reqtrace.emit(
+            self.logger, trace_id=trace.trace_id, where="replica",
+            status=status, wall_ms=wall_ms, phases=trace.phases,
+            sampled=not (failed or slow or trace.keep_hint),
+            path=path, tenant=tenant or self.default_tenant,
+            method=method or self.default_method, replica=self.replica,
+            cold=trace.cold, batch_fill=trace.batch_fill)
+
     # --------------------------------------------------------- stats / SLO
 
     def stats_record(self) -> dict:
@@ -529,6 +583,7 @@ class ServeService:
             "model_steps": dict(self.model_steps),
             "replica": self.replica,
             "programs": self.engine.program_stats(),
+            "phases": obs_reqtrace.phase_summary(reg),
             "uptime_s": round(time.time() - self._started_ts, 3),
         }
 
@@ -548,7 +603,8 @@ class ServeService:
             obs_registry.set_gauge("serve_p95_ms", rec["p95_ms"])
         obs_slo.check_serve(point=self._stats_seq, p95_ms=rec["p95_ms"],
                             queue_depth=queue_depth,
-                            reject_frac=reject_frac, logger=self.logger)
+                            reject_frac=reject_frac, logger=self.logger,
+                            phases=rec.get("phases"))
         return rec
 
 

@@ -1,0 +1,753 @@
+"""Request observatory acceptance (obs/reqtrace.py + the serve path).
+
+Four tiers, all tier-1:
+
+* **policy units** — deterministic head-sampling by trace-id hash (the
+  same answer in every process), tail-biased retention (failed / slow /
+  flagged requests ALWAYS keep their record), the slow-threshold
+  resolution chain (serve.trace_slow_ms -> obs.slo_serve_p95_ms ->
+  250 ms default), and the config bounds check;
+* **seam units** — the batcher's queue/coalesce span boundary (a lone
+  partial-batch request charges the coalescing window, a full-batch
+  departure charges only queue service), the engine's dispatch/fetch
+  split riding ``last_dispatch_info``, the tail-attribution verdict
+  naming an injected dominant phase with checkable exemplar trace ids,
+  and the router edge against fake stdlib replicas: X-Trace-Id minted /
+  echoed, X-Trace-Keep hinted to later hops after a transport failure,
+  the failover's flagged record kept even at sample fraction 0;
+* **tooling units** — Perfetto request lanes stitched per trace id with
+  retried/failed marks, request_report's exit contract, run_monitor /
+  postmortem request-breakdown blocks, perf_sentry's per-phase
+  regression check (slack * threshold plus an absolute ms floor), and
+  validate_metrics' serve_trace schema;
+* **the 2-replica trace drill** — a real ``cli serve`` fleet at
+  ``serve.trace_sample_frac=1.0``: SIGKILL one replica mid-load and pin
+  the failover request's trace end to end — the client's echoed id, the
+  router record naming the dead attempt and the winning one, the
+  winning replica's record under the SAME id, the stitched Perfetto
+  lane, and the attribution report over the stream.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from data_diet_distributed_tpu.config import load_config
+from data_diet_distributed_tpu.obs import MetricsRegistry
+from data_diet_distributed_tpu.obs import registry as obs_registry
+from data_diet_distributed_tpu.obs import reqtrace
+from data_diet_distributed_tpu.obs import timeline as tl
+from data_diet_distributed_tpu.serve.batcher import ScoreBatcher
+from data_diet_distributed_tpu.serve.router import Replica, ServeRouter
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stream_recs(path):
+    recs = []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue   # partial trailing line from a killed run
+    return recs
+
+
+class _ListLogger:
+    """Captures emitted records in-process, MetricsLogger-shaped."""
+
+    def __init__(self):
+        self.records = []
+
+    def log(self, kind, **fields):
+        self.records.append({"kind": kind, "ts": time.time(), **fields})
+
+    def of(self, kind):
+        return [r for r in self.records if r["kind"] == kind]
+
+
+# ======================================================================
+# retention policy
+# ======================================================================
+
+class TestSamplingPolicy:
+    def test_keep_fraction_edges_and_determinism(self):
+        tid = reqtrace.mint_trace_id()
+        assert reqtrace.keep_fraction(tid, 1.0) is True
+        assert reqtrace.keep_fraction(tid, 0.0) is False
+        assert reqtrace.keep_fraction("", 0.5) is False
+        # Deterministic: the same id answers the same way every time —
+        # the property that lets router and replicas agree with no
+        # coordination header on the happy path.
+        first = reqtrace.keep_fraction(tid, 0.3)
+        assert all(reqtrace.keep_fraction(tid, 0.3) == first
+                   for _ in range(50))
+
+    def test_keep_fraction_hits_the_fraction(self):
+        ids = [reqtrace.mint_trace_id() for _ in range(4000)]
+        kept = sum(reqtrace.keep_fraction(t, 0.5) for t in ids)
+        assert 0.42 < kept / len(ids) < 0.58
+
+    def test_tail_bias_always_keeps_interesting_requests(self):
+        # An id head-sampling would DROP at frac 0 still keeps when the
+        # request failed, ran slow, or was flagged by an earlier hop.
+        tid = reqtrace.mint_trace_id()
+        assert reqtrace.should_keep(tid, 0.0) is False
+        assert reqtrace.should_keep(tid, 0.0, failed=True) is True
+        assert reqtrace.should_keep(tid, 0.0, slow=True) is True
+        assert reqtrace.should_keep(tid, 0.0, flagged=True) is True
+        assert reqtrace.should_keep(tid, 1.0) is True
+
+    def test_slow_threshold_resolution_chain(self, tmp_path):
+        base = ["data.dataset=synthetic", "data.synthetic_size=64",
+                f"obs.metrics_path={tmp_path}/m.jsonl"]
+        explicit = load_config(None, base + ["serve.trace_slow_ms=123.0",
+                                             "obs.slo_serve_p95_ms=50"])
+        assert reqtrace.slow_threshold_ms(explicit) == 123.0
+        via_slo = load_config(None, base + ["obs.slo_serve_p95_ms=50"])
+        assert reqtrace.slow_threshold_ms(via_slo) == 50.0
+        neither = load_config(None, base)
+        assert reqtrace.slow_threshold_ms(neither) == reqtrace.DEFAULT_SLOW_MS
+
+    def test_config_rejects_out_of_range_sample_frac(self, tmp_path):
+        with pytest.raises(ValueError, match="trace_sample_frac"):
+            load_config(None, ["data.dataset=synthetic",
+                               f"obs.metrics_path={tmp_path}/m.jsonl",
+                               "serve.trace_sample_frac=1.5"])
+
+
+# ======================================================================
+# attribution
+# ======================================================================
+
+def _trace_rec(tid, wall, where="replica", **phases):
+    return {"kind": "serve_trace", "ts": 100.0, "trace_id": tid,
+            "where": where, "status": 200, "wall_ms": float(wall),
+            "phases": {k: float(v) for k, v in phases.items()},
+            "sampled": True}
+
+
+class TestAttribution:
+    def test_names_injected_dispatch_dominant_tail(self):
+        recs = [_trace_rec(f"fast{i:028d}", 5.0 + 0.1 * i, queue_wait=3.0,
+                           dispatch=1.0) for i in range(30)]
+        slow = [_trace_rec(f"slow{i:028d}", 400.0 + i, queue_wait=5.0,
+                           dispatch=390.0 + i) for i in range(2)]
+        attr = reqtrace.attribute(recs + slow)
+        assert attr["requests"] == 32
+        tail = attr["tail"]
+        assert tail["dominant_phase"] == "dispatch"
+        ex = [e["trace_id"] for e in tail["exemplars"]["dispatch"]]
+        assert f"slow{1:028d}" in ex   # the slowest wall leads
+        assert attr["phases"]["dispatch"]["max_ms"] >= 390.0
+
+    def test_names_injected_queue_dominant_tail(self):
+        recs = [_trace_rec(f"fast{i:028d}", 10.0, queue_wait=2.0,
+                           dispatch=7.0) for i in range(30)]
+        slow = [_trace_rec(f"wait{i:028d}", 500.0, queue_wait=480.0,
+                           dispatch=15.0) for i in range(3)]
+        tail = reqtrace.attribute(recs + slow)["tail"]
+        assert tail["dominant_phase"] == "queue_wait"
+        assert tail["phase_counts"]["queue_wait"] == 3
+
+    def test_where_filter_splits_sides(self):
+        recs = [_trace_rec("a" * 32, 50.0, where="router", proxy=40.0,
+                           routing=8.0, admission=2.0),
+                _trace_rec("a" * 32, 45.0, where="replica", dispatch=40.0,
+                           queue_wait=5.0)]
+        router_view = reqtrace.attribute(recs, where="router")
+        assert router_view["requests"] == 1
+        assert router_view["tail"]["dominant_phase"] == "proxy"
+        replica_view = reqtrace.attribute(recs, where="replica")
+        assert replica_view["tail"]["dominant_phase"] == "dispatch"
+
+    def test_empty_and_non_trace_records(self):
+        attr = reqtrace.attribute([{"kind": "epoch", "ts": 1.0}])
+        assert attr["requests"] == 0 and attr["tail"] is None
+
+    def test_single_record_degenerate_tail(self):
+        attr = reqtrace.attribute([_trace_rec("x" * 32, 5.0, fetch=4.0)])
+        assert attr["tail"]["dominant_phase"] == "fetch"
+
+
+# ======================================================================
+# batcher span seams (fake engine: the batcher only needs batch_size +
+# score_batch, optionally last_dispatch_info)
+# ======================================================================
+
+class _FakeEngine:
+    batch_size = 8
+
+    def __init__(self, info=None):
+        self.info = info
+
+    def score_batch(self, tenant, method, images, labels):
+        if self.info is not None:
+            self.last_dispatch_info = dict(self.info)
+        return np.arange(len(images), dtype=np.float32)
+
+
+class TestBatcherSpans:
+    def test_lone_partial_request_charges_the_coalesce_window(self):
+        b = ScoreBatcher(_FakeEngine(), coalesce_window_s=0.05,
+                         request_log=False).start()
+        try:
+            trace = reqtrace.RequestTrace(reqtrace.mint_trace_id())
+            b.submit("t", "el2n", np.zeros((2, 4, 4, 1), np.float32),
+                     np.zeros(2, np.int32), timeout_s=30.0, trace=trace)
+        finally:
+            b.stop()
+        # The first dispatch departed window-expired (2 < 8 rows), so up
+        # to the whole window is coalescing, the remainder queue service.
+        assert trace.phases["coalesce_wait"] == pytest.approx(50.0, abs=1.0)
+        assert trace.phases["queue_wait"] >= 0.0
+        assert trace.phases["dispatch"] > 0.0
+        assert trace.batch_fill == pytest.approx(2 / 8)
+
+    def test_full_batch_departure_never_waits_on_the_window(self):
+        b = ScoreBatcher(_FakeEngine(), coalesce_window_s=0.05,
+                         request_log=False).start()
+        try:
+            trace = reqtrace.RequestTrace(reqtrace.mint_trace_id())
+            b.submit("t", "el2n", np.zeros((8, 4, 4, 1), np.float32),
+                     np.zeros(8, np.int32), timeout_s=30.0, trace=trace)
+        finally:
+            b.stop()
+        assert trace.phases["coalesce_wait"] == 0.0
+        assert trace.phases["queue_wait"] < 50.0   # no window charged
+
+    def test_engine_dispatch_fetch_split_rides_last_dispatch_info(self):
+        eng = _FakeEngine(info={"dispatch_ms": 7.0, "compile_ms": 2.0,
+                                "fetch_ms": 3.0, "cold": True})
+        b = ScoreBatcher(eng, coalesce_window_s=0.001,
+                         request_log=False).start()
+        try:
+            trace = reqtrace.RequestTrace(reqtrace.mint_trace_id())
+            b.submit("t", "el2n", np.zeros((8, 4, 4, 1), np.float32),
+                     np.zeros(8, np.int32), timeout_s=30.0, trace=trace)
+        finally:
+            b.stop()
+        assert trace.phases["dispatch"] == pytest.approx(9.0)   # + compile
+        assert trace.phases["fetch"] == pytest.approx(3.0)
+        assert trace.cold is True
+
+    def test_request_trace_accumulates_split_dispatches(self):
+        t = reqtrace.RequestTrace("r" * 32, keep_hint=True)
+        t.add_ms("dispatch", 4.0)
+        t.add_ms("dispatch", 6.0)
+        assert t.phases["dispatch"] == pytest.approx(10.0)
+        assert t.keep_hint is True and t.wall_ms() >= 0.0
+
+
+# ----------------------------------------------------- phase histograms
+
+def test_observe_phases_feeds_registry_and_phase_summary():
+    reg = obs_registry.install(MetricsRegistry())
+    try:
+        reqtrace.observe_phases({"dispatch": 5.0, "queue_wait": None})
+        summ = reqtrace.phase_summary()
+        assert summ["dispatch"]["count"] == 1
+        assert summ["dispatch"]["max"] == pytest.approx(5.0)
+        assert "queue_wait" not in summ   # null phases never observed
+        assert reg.snapshot()["histograms"][
+            reqtrace.PHASE_HIST_PREFIX + "dispatch"]["count"] == 1
+    finally:
+        obs_registry.uninstall()
+    assert reqtrace.phase_summary() == {}   # uninstalled: empty, no crash
+
+
+def test_router_stats_carry_the_phase_aggregate():
+    obs_registry.install(MetricsRegistry())
+    try:
+        reqtrace.observe_phases({"proxy": 12.0, "routing": 1.0})
+        stats = ServeRouter([]).stats()
+        assert stats["phases"]["proxy"]["count"] == 1
+    finally:
+        obs_registry.uninstall()
+
+
+# ======================================================================
+# router edge, against fake stdlib replicas
+# ======================================================================
+
+class _TraceFakeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # noqa: A002
+        pass
+
+    def do_POST(self):   # noqa: N802
+        fake = self.server.fake
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n) if n else b""
+        with fake.lock:
+            fake.seen.append({k: v for k, v in self.headers.items()})
+        body = json.dumps({"scores": [float(fake.index)]}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST   # noqa: N815
+
+
+class _TraceFake:
+    def __init__(self, index):
+        self.index = index
+        self.seen: list[dict] = []
+        self.lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _TraceFakeHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.fake = self
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def trace_fakes():
+    pair = [_TraceFake(0), _TraceFake(1)]
+    yield pair
+    for f in pair:
+        try:
+            f.kill()
+        except OSError:
+            pass
+
+
+def _mk_router(fakes, **kw):
+    reps = [Replica(f.index, "127.0.0.1", f.port, breaker_failures=3,
+                    breaker_reset_s=0.3) for f in fakes]
+    router = ServeRouter(reps, timeout_s=10.0, **kw)
+    router.bind()
+    return router
+
+
+def _post(router, headers=None, key=None):
+    hdrs = {"Content-Type": "application/json"}
+    if key:
+        hdrs["Idempotency-Key"] = key
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/v1/score",
+        data=json.dumps({"indices": [0]}).encode(), headers=hdrs,
+        method="POST")
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, json.load(resp), dict(resp.headers)
+
+
+class TestRouterTraceEdge:
+    def test_echoes_client_id_and_mints_when_absent(self, trace_fakes):
+        lg = _ListLogger()
+        router = _mk_router(trace_fakes, logger=lg, trace_sample_frac=1.0)
+        try:
+            given = "ab" * 16
+            status, _, hdrs = _post(router, headers={"X-Trace-Id": given})
+            assert status == 200 and hdrs["X-Trace-Id"] == given
+            status, _, hdrs = _post(router)
+            minted = hdrs["X-Trace-Id"]
+            assert status == 200 and len(minted) == 32 and minted != given
+        finally:
+            router.stop()
+        ids = {r["trace_id"] for r in lg.of("serve_trace")}
+        assert {given, minted} <= ids   # frac=1.0 retains both
+        rec = next(r for r in lg.of("serve_trace") if r["trace_id"] == given)
+        assert rec["where"] == "router" and rec["sampled"] is True
+        assert set(rec["phases"]) == set(reqtrace.ROUTER_PHASES)
+
+    def test_failover_keeps_flagged_trace_and_hints_later_hops(
+            self, trace_fakes):
+        trace_fakes[0].kill()
+        lg = _ListLogger()
+        # frac=0.0: tail-only retention — ONLY the failover's flag keeps it.
+        router = _mk_router(trace_fakes, logger=lg, trace_sample_frac=0.0)
+        try:
+            status, body, hdrs = _post(router, key="k1")
+            assert status == 200 and body["scores"] == [1.0]
+            tid = hdrs["X-Trace-Id"]
+            # The winning replica saw the same id plus the keep hint the
+            # router set after the transport failure.
+            seen = trace_fakes[1].seen[0]
+            got = {k.lower(): v for k, v in seen.items()}
+            assert got["x-trace-id"] == tid
+            assert got["x-trace-keep"] == "1"
+        finally:
+            router.stop()
+        recs = lg.of("serve_trace")
+        assert len(recs) == 1   # healthy traffic would have been dropped
+        rec = recs[0]
+        assert rec["trace_id"] == tid and rec["retries"] == 1
+        assert rec["sampled"] is False
+        outcomes = [a["outcome"] for a in rec["attempts"]]
+        assert outcomes == ["transport_error", "ok"]
+        assert rec["attempts"][0]["replica"] == 0
+        assert rec["attempts"][1]["replica"] == 1 == rec["replica"]
+
+    def test_healthy_traffic_drops_at_frac_zero(self, trace_fakes):
+        lg = _ListLogger()
+        router = _mk_router(trace_fakes, logger=lg, trace_sample_frac=0.0)
+        try:
+            for _ in range(4):
+                status, _, hdrs = _post(router)
+                assert status == 200 and hdrs["X-Trace-Id"]
+        finally:
+            router.stop()
+        assert lg.of("serve_trace") == []
+
+
+# ======================================================================
+# tooling: timeline lanes, reports, sentry, schema
+# ======================================================================
+
+def _stitched_records():
+    tid_a, tid_b = "a" * 32, "b" * 32
+    return [
+        {"kind": "serve_trace", "ts": 100.0, "trace_id": tid_a,
+         "where": "router", "status": 200, "wall_ms": 30.0,
+         "phases": {"admission": 1.0, "routing": 9.0, "proxy": 20.0},
+         "sampled": False, "retries": 1, "replica": 1,
+         "attempts": [{"replica": 0, "outcome": "transport_error",
+                       "hedge": False, "ms": 8.0},
+                      {"replica": 1, "outcome": "ok", "hedge": False,
+                       "ms": 20.0}]},
+        {"kind": "serve_trace", "ts": 100.0, "trace_id": tid_a,
+         "where": "replica", "status": 200, "wall_ms": 18.0,
+         "phases": {"queue_wait": 2.0, "coalesce_wait": 1.0,
+                    "dispatch": 12.0, "fetch": 2.0, "serialize": 1.0},
+         "sampled": False, "replica": 1},
+        {"kind": "serve_trace", "ts": 101.0, "trace_id": tid_b,
+         "where": "router", "status": 503, "wall_ms": 5.0,
+         "phases": {"admission": 1.0, "routing": 4.0, "proxy": 0.0},
+         "sampled": False, "retries": 0, "replica": None},
+    ]
+
+
+def test_perfetto_stitches_one_lane_per_request(tmp_path):
+    out = tmp_path / "merged.json"
+    counts = tl.merge_perfetto([], str(out), records=_stitched_records())
+    assert counts["request_lanes"] == 2
+    events = json.load(open(out))
+    names = [e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert f"request {'a' * 12} [retried]" in names
+    assert f"request {'b' * 12} [failed]" in names
+    lane_a = [e for e in events if e.get("ph") == "X"
+              and e.get("args", {}).get("trace_id") == "a" * 32]
+    # Router spans on tid 0, the winning replica's on tid 1+index — one
+    # lane holds BOTH processes' halves of the request.
+    assert {e["tid"] for e in lane_a} == {0, 2}
+    assert {e["name"] for e in lane_a if e["tid"] == 0} == \
+        set(reqtrace.ROUTER_PHASES)
+    marks = [e for e in events if e.get("ph") == "i"
+             and e.get("cat") == "serve_trace"]
+    assert any(e["name"] == "retried" for e in marks)
+    assert any(e["name"] == "failed" for e in marks)
+
+
+def test_request_report_exit_contract(tmp_path, capsys):
+    rr = _load_tool("request_report")
+    metrics = tmp_path / "m.jsonl"
+    with open(metrics, "w") as fh:
+        for rec in _stitched_records():
+            fh.write(json.dumps(rec) + "\n")
+    assert rr.main([str(metrics), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["requests"] == 3
+    assert report["tail"]["dominant_phase"] in reqtrace.ALL_PHASES
+    assert report["by_side"]["router"]["requests"] == 2
+    assert report["by_side"]["replica"]["requests"] == 1
+    empty = tmp_path / "empty.jsonl"
+    with open(empty, "w") as fh:
+        fh.write(json.dumps({"kind": "epoch", "ts": 1.0, "epoch": 0}) + "\n")
+    assert rr.main([str(empty)]) == 2
+
+
+def test_run_monitor_gathers_request_breakdown(tmp_path):
+    rm = _load_tool("run_monitor")
+    metrics = tmp_path / "m.jsonl"
+    with open(metrics, "w") as fh:
+        for rec in _stitched_records():
+            fh.write(json.dumps(rec) + "\n")
+    info = rm.gather_files(str(metrics), None, 120, lineage=False)
+    req = info["requests"]
+    assert req["traced"] == 3
+    assert req["dominant_phase"] in reqtrace.ALL_PHASES
+    assert set(req["phases"]) >= {"routing", "dispatch"}
+    assert all(len(t) == 32 for t in req["exemplars"])
+    assert "requests:" in rm.render(info)
+
+
+def test_postmortem_report_carries_request_breakdown(tmp_path):
+    pm = _load_tool("postmortem")
+    report = pm.build_report({"records": _stitched_records()})
+    assert report["requests"]["traced"] == 3
+    assert report["requests"]["dominant_phase"] in reqtrace.ALL_PHASES
+    assert "requests:" in pm.render(report)
+
+
+class TestPerfSentryPhases:
+    def _rec(self, value, queue_p95, n):
+        return {"kind": "perf_history", "ts": float(n),
+                "metric": "serve_p95_ms", "backend": "cpu",
+                "value": value, "unit": "ms",
+                "phases": {"queue_wait": {"p50_ms": queue_p95 / 2,
+                                          "p95_ms": queue_p95},
+                           "dispatch": {"p50_ms": 20.0, "p95_ms": 40.0}}}
+
+    def test_flags_single_phase_regression_behind_flat_headline(self):
+        ps = _load_tool("perf_sentry")
+        # Headline p95 flat at 100 ms; queue_wait p95 jumps 10 -> 16 ms
+        # (-60% at a 10% threshold * 3.0 slack = -30% bar, +6 ms >= the
+        # 5 ms floor): the group regresses on the phase alone.
+        recs = [self._rec(100.0, 10.0, i) for i in range(3)] \
+            + [self._rec(100.0, 16.0, 3)]
+        verdict = ps.check_ledger(recs, threshold=0.10)
+        assert verdict["exit_code"] == ps.EXIT_REGRESSION
+        group = verdict["groups"][0]
+        assert group["status"] == ps.REGRESSION
+        assert "queue_wait" in group["phase_regressions"]
+        assert "dispatch" not in group["phase_regressions"]
+        assert "PHASE queue_wait" in ps.render(verdict)
+
+    def test_absolute_floor_absorbs_tiny_phase_noise(self):
+        ps = _load_tool("perf_sentry")
+        # 1 -> 4 ms is -300% but only +3 ms: under the 5 ms floor, noise.
+        recs = [self._rec(100.0, 1.0, i) for i in range(3)] \
+            + [self._rec(100.0, 4.0, 3)]
+        verdict = ps.check_ledger(recs, threshold=0.10)
+        assert verdict["exit_code"] == ps.EXIT_OK
+
+    def test_needs_two_clean_phase_samples(self):
+        ps = _load_tool("perf_sentry")
+        recs = [self._rec(100.0, 10.0, 0), self._rec(100.0, 60.0, 1)]
+        verdict = ps.check_ledger(recs, threshold=0.10)
+        assert verdict["exit_code"] == ps.EXIT_OK
+        assert "phase_regressions" not in verdict["groups"][0]
+
+
+def test_validate_metrics_serve_trace_schema(tmp_path):
+    vm = _load_tool("validate_metrics")
+    good = _stitched_records()[0]
+    assert vm.validate_lines([json.dumps(good)]) == []
+    missing = {k: v for k, v in good.items() if k != "phases"}
+    assert any("phases" in p for p in vm.validate_lines([json.dumps(missing)]))
+    bad = dict(good, phases=[1, 2, 3])
+    assert any("object" in p for p in vm.validate_lines([json.dumps(bad)]))
+
+
+# ======================================================================
+# the 2-replica trace drill
+# ======================================================================
+
+_FLEET_ARGS = [
+    "data.dataset=synthetic", "data.synthetic_size=256",
+    "data.batch_size=64", "model.arch=tiny_cnn",
+    "train.half_precision=false", "score.pretrain_epochs=0",
+    "score.batch_size=64", "score.method=el2n",
+    "serve.router_port=0", "serve.port=0", "serve.tenant=tiny",
+    "serve.coalesce_ms=2", "serve.warm=false",
+    "serve.health_poll_s=0.25", "serve.breaker_reset_s=0.5",
+    "serve.request_timeout_s=120",
+    "elastic.max_restarts=4", "elastic.backoff_s=0.2"]
+
+
+def _drill_env(plan):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "DDT_FAULT_PLAN")}
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=str(REPO),
+               DDT_FAULT_PLAN=json.dumps(plan))
+    return env
+
+
+def _launch_fleet(tmp_path, env, *extra):
+    metrics = tmp_path / "metrics.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "data_diet_distributed_tpu.cli", "serve",
+         *_FLEET_ARGS,
+         f"obs.metrics_path={metrics}",
+         f"obs.heartbeat_dir={tmp_path}/hb",
+         f"train.checkpoint_dir={tmp_path}/ckpt", *extra],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    return proc, metrics
+
+
+def _router_url(proc, metrics, budget_s=120):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        if metrics.exists():
+            for rec in _stream_recs(metrics):
+                if rec.get("kind") == "serve_fleet" \
+                        and rec.get("event") == "launch":
+                    return f"http://127.0.0.1:{rec['router_port']}"
+        time.sleep(0.25)
+    raise AssertionError("fleet never published its router port")
+
+
+def _wait_available(proc, probe, sc, n, budget_s):
+    deadline = time.monotonic() + budget_s
+    verdict = None
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        try:
+            verdict = probe.healthz()
+        except sc.ServeError:
+            verdict = None
+        if verdict and verdict.get("available") == n:
+            return verdict
+        time.sleep(0.25)
+    raise AssertionError(f"fleet never reached {n} available: {verdict}")
+
+
+def _wait_record(proc, metrics, pred, what, budget_s):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        assert proc.poll() is None, proc.stdout.read()[-4000:]
+        for rec in _stream_recs(metrics):
+            if pred(rec):
+                return rec
+        time.sleep(0.4)
+    raise AssertionError(f"no {what} record within {budget_s}s")
+
+
+class TestTraceFleetDrill:
+    """SIGKILL replica 1 mid-load at trace_sample_frac=1.0 and follow ONE
+    request across the failover: the client's echoed id, the router
+    record naming the dead attempt and the winner, the winning replica's
+    spans under the same id, the stitched Perfetto lane, and the
+    attribution tooling over the terminal stream."""
+
+    @pytest.fixture(scope="class")
+    def drill(self, tmp_path_factory):
+        tmp_path = tmp_path_factory.mktemp("trace_drill")
+        # Replica 1 SIGKILLs itself with its 5th dispatch in flight.
+        env = _drill_env({"rank": 1, "kill_replica_after_requests": 4})
+        proc, metrics = _launch_fleet(
+            tmp_path, env, "serve.replicas=2",
+            "serve.trace_sample_frac=1.0", "serve.stats_every_s=2")
+        sc = _load_tool("serve_client")
+        out = dict(metrics=metrics)
+        try:
+            url = _router_url(proc, metrics)
+            probe = sc.ServeClient(url, timeout_s=15.0, retries=6)
+            _wait_available(proc, probe, sc, 2, 240)
+            out["echo_sent"] = "cafe" * 8
+            probe.score(indices=[0, 1], trace_id=out["echo_sent"])
+            out["echo_got"] = probe.last_trace_id
+            out["load"] = sc.load_generate(
+                url, rps=12, duration_s=8, batch=8, max_index=255,
+                timeout_s=120, retries=6, backoff_s=0.25)
+            out["failover"] = _wait_record(
+                proc, metrics,
+                lambda r: r.get("kind") == "serve_trace"
+                and r.get("where") == "router"
+                and (r.get("retries") or 0) > 0,
+                "retried router serve_trace", 90)
+            _wait_available(proc, probe, sc, 2, 120)
+            proc.send_signal(signal.SIGTERM)
+            out["rc"] = proc.wait(timeout=120)
+            out["stdout"] = proc.stdout.read()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out["records"] = _stream_recs(metrics)
+        return out
+
+    def test_clean_exit_and_zero_client_visible_failures(self, drill):
+        assert drill["rc"] == 75, drill["stdout"][-4000:]
+        assert drill["load"]["errors"] == 0, drill["load"]
+        assert drill["load"]["ok"] > 0
+
+    def test_client_sees_its_own_trace_id_echoed(self, drill):
+        assert drill["echo_got"] == drill["echo_sent"]
+        slowest = drill["load"]["slowest"]
+        assert slowest and all(len(r["trace_id"]) == 32 and r["ms"] > 0
+                               for r in slowest)
+
+    def test_failover_request_is_one_trace_end_to_end(self, drill):
+        rec = drill["failover"]
+        tid = rec["trace_id"]
+        assert rec["sampled"] is False   # flagged: kept at ANY sample frac
+        outcomes = [a["outcome"] for a in rec["attempts"]]
+        assert "transport_error" in outcomes and "ok" in outcomes
+        dead = next(a["replica"] for a in rec["attempts"]
+                    if a["outcome"] != "ok")
+        win = next(a["replica"] for a in rec["attempts"]
+                   if a["outcome"] == "ok")
+        assert dead != win and rec["replica"] == win
+        # The winning replica's spans landed in the SAME stream under the
+        # SAME id: the cross-process stitch the lane is built from.
+        replica_side = [r for r in drill["records"]
+                        if r.get("kind") == "serve_trace"
+                        and r.get("where") == "replica"
+                        and r.get("trace_id") == tid]
+        assert replica_side, f"no replica-side record for trace {tid}"
+        phases = replica_side[0]["phases"]
+        assert set(phases) >= {"queue_wait", "dispatch", "serialize"}
+
+    def test_perfetto_lane_stitches_the_failover(self, drill, tmp_path):
+        out = tmp_path / "merged.json"
+        counts = tl.merge_perfetto([], str(out), records=drill["records"])
+        assert counts["request_lanes"] > 0
+        tid = drill["failover"]["trace_id"]
+        events = json.load(open(out))
+        lane_names = [e["args"]["name"] for e in events
+                      if e.get("ph") == "M"
+                      and e.get("name") == "process_name"]
+        assert any(name.startswith(f"request {tid[:12]}")
+                   and "retried" in name for name in lane_names)
+
+    def test_attribution_tooling_reads_the_stream(self, drill):
+        rr = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "request_report.py"),
+             str(drill["metrics"]), "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert rr.returncode == 0, rr.stdout + rr.stderr
+        report = json.loads(rr.stdout)
+        assert report["requests"] > 0
+        assert report["tail"]["dominant_phase"] in reqtrace.ALL_PHASES
+        # Both sides of the stitch are present in the one stream.
+        assert report["by_side"]["router"]["requests"] > 0
+        assert report["by_side"]["replica"]["requests"] > 0
+        rm = _load_tool("run_monitor")
+        info = rm.gather_files(str(drill["metrics"]), None, 120,
+                               lineage=False)
+        assert info["requests"]["traced"] > 0
+        assert info["requests"]["dominant_phase"] in reqtrace.ALL_PHASES
+
+    def test_terminal_stream_validates(self, drill):
+        vm = _load_tool("validate_metrics")
+        problems = vm.validate_file(str(drill["metrics"]),
+                                    expect_terminal=True)
+        assert problems == [], problems

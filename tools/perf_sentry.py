@@ -83,6 +83,29 @@ def comm_bytes_per_step(rec: dict) -> float | None:
     return None
 
 
+#: Serve-phase p95s are MEASURED latencies (scheduler noise, CI load),
+#: unlike the analytic comm bytes — the per-phase check therefore fires
+#: only past ``threshold * PHASE_SLACK`` AND an absolute floor, so a
+#: 0.1 ms serialize phase tripling never fails a run.
+PHASE_SLACK = 3.0
+PHASE_MIN_DELTA_MS = 5.0
+
+
+def phase_p95s(rec: dict) -> dict[str, float]:
+    """``{phase: p95_ms}`` from the record's serve-phase breakdown
+    (bench.py's ``phases`` block, the request observatory's per-phase
+    aggregate), or ``{}`` when absent."""
+    phases = rec.get("phases")
+    if not isinstance(phases, dict):
+        return {}
+    out: dict[str, float] = {}
+    for name, s in phases.items():
+        v = s.get("p95_ms") if isinstance(s, dict) else None
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            out[str(name)] = float(v)
+    return out
+
+
 def group_key(rec: dict) -> str:
     """Records are only comparable within the same (metric, backend,
     geometry) shape; geometry dicts canonicalize by sorted keys. Backfilled
@@ -189,6 +212,31 @@ def check_group(records: list[dict], *, threshold: float,
             if cdelta < -threshold:
                 out["status"] = REGRESSION
                 out["comm_regression"] = True
+    # Serve-phase sub-metrics (records carrying bench's "phases" block):
+    # a regression hiding inside ONE phase — queue wait doubling while
+    # dispatch got faster — can leave total p95 inside its threshold.
+    # Phases are lower-better ms like the headline serve metric, but
+    # noisy, so the bar is threshold * PHASE_SLACK plus an absolute
+    # floor, and the baseline needs >= 2 clean samples of that phase.
+    new_phases = phase_p95s(newest)
+    if new_phases:
+        regressed: dict[str, dict] = {}
+        for name, nv in sorted(new_phases.items()):
+            hist = [phase_p95s(r).get(name) for r in records[:-1]
+                    if classify_record(r) == CLEAN]
+            hist = [v for v in hist if v is not None][-window:]
+            if len(hist) < 2:
+                continue
+            pb = _median(hist)
+            pdelta = -(nv - pb) / pb   # lower-better: positive = better
+            if (pdelta < -(threshold * PHASE_SLACK)
+                    and nv - pb >= PHASE_MIN_DELTA_MS):
+                regressed[name] = {"p95_ms": nv,
+                                   "baseline_median": round(pb, 3),
+                                   "delta_frac": round(pdelta, 4)}
+        if regressed:
+            out["status"] = REGRESSION
+            out["phase_regressions"] = regressed
     return out
 
 
@@ -277,6 +325,10 @@ def render(report: dict) -> str:
             line += (f" — COMM {g['comm_bytes_per_step']:.0f} B/step vs "
                      f"median {g['comm_baseline_median']:.0f} "
                      f"({g['comm_delta_frac'] * 100:+.1f}%)")
+        for name, p in (g.get("phase_regressions") or {}).items():
+            line += (f" — PHASE {name} {p['p95_ms']} ms vs median "
+                     f"{p['baseline_median']} "
+                     f"({p['delta_frac'] * 100:+.1f}%)")
         if g.get("error"):
             line += f" — {g['error']}"
         lines.append(line)

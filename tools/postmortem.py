@@ -40,6 +40,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from data_diet_distributed_tpu.obs import reqtrace  # noqa: E402
 from data_diet_distributed_tpu.obs import timeline  # noqa: E402
 
 EXIT_CLEAN, EXIT_SUSPECT, EXIT_UNREADABLE = 0, 1, 2
@@ -96,6 +97,24 @@ def build_report(artifacts: dict, *,
                                              "epoch", "stage")}
                       for r in artifacts.get("heartbeat_residue") or []],
                   tier_steps=artifacts.get("tier_steps") or [])
+    traces = [r for r in records if r.get("kind") == "serve_trace"]
+    if traces:
+        # Request-latency breakdown over the run's serve_trace records —
+        # which phase the tail lived in, with exemplar trace ids. Display
+        # evidence, never a problem: slow requests already surface as
+        # slo_violation records when out of contract.
+        attr = reqtrace.attribute(traces)
+        tail = attr.get("tail") or {}
+        report["requests"] = {
+            "traced": attr["requests"],
+            "phases": {p: {"p50_ms": s["p50_ms"], "p95_ms": s["p95_ms"]}
+                       for p, s in (attr.get("phases") or {}).items()},
+            "dominant_phase": tail.get("dominant_phase"),
+            "tail_threshold_ms": tail.get("threshold_ms"),
+            "exemplars": [e["trace_id"] for e in
+                          (tail.get("exemplars") or {}).get(
+                              tail.get("dominant_phase"), [])],
+        }
     problems += [f"unexplained: {u}" for u in view["unexplained"]]
     if view["slo_violations"]:
         problems.append(f"{view['slo_violations']} slo_violation record(s)")
@@ -179,6 +198,16 @@ def render(report: dict, timeline_events: list[dict] | None = None,
         lines.append(f"residue: rank {r.get('rank')} last heartbeat in "
                      f"attempt {r.get('attempt')} at step {r.get('step')} "
                      f"(stage {r.get('stage')})")
+    rq = report.get("requests")
+    if rq:
+        lines.append(f"requests: {rq['traced']} traced — dominant tail "
+                     f"phase {rq.get('dominant_phase') or '-'}")
+        for p, s in (rq.get("phases") or {}).items():
+            lines.append(f"  {p:>14}: p50 {s.get('p50_ms')}ms  "
+                         f"p95 {s.get('p95_ms')}ms")
+        if rq.get("exemplars"):
+            lines.append("  exemplars: "
+                         + ", ".join(t[:12] for t in rq["exemplars"]))
     lines.append(f"slo: {report.get('slo_violations', 0)} violation "
                  "record(s)")
     term = report.get("terminal")

@@ -127,7 +127,7 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                                       "serve_fleet", "replica_event",
                                       "model_refresh", "autoscale_event",
                                       "data_plane", "data_fault",
-                                      "shard_quarantine"))
+                                      "shard_quarantine", "serve_trace"))
         view = None
         if lineage:
             from data_diet_distributed_tpu.obs.timeline import (lineage_view,
@@ -252,6 +252,24 @@ def gather_files(metrics: str | None, heartbeat_dir: str | None,
                 "last_fault": (faults[-1].get("error_class")
                                if faults else None),
                 "recovered": recovered if quarantines else None,
+            }
+        traces = [r for r in recs if r.get("kind") == "serve_trace"]
+        if traces:
+            # Display-only request-latency breakdown: which PHASE the serve
+            # path spends its tail in, with exemplar trace ids an operator
+            # can paste into tools/request_report.py / the Perfetto view.
+            from data_diet_distributed_tpu.obs import reqtrace
+            attr = reqtrace.attribute(traces)
+            tail = attr.get("tail") or {}
+            out["requests"] = {
+                "traced": attr["requests"],
+                "phases": {p: {"p50_ms": s["p50_ms"], "p95_ms": s["p95_ms"]}
+                           for p, s in (attr.get("phases") or {}).items()},
+                "dominant_phase": tail.get("dominant_phase"),
+                "tail_threshold_ms": tail.get("threshold_ms"),
+                "exemplars": [e["trace_id"] for e in
+                              (tail.get("exemplars") or {}).get(
+                                  tail.get("dominant_phase"), [])],
             }
         soak = [r for r in recs if r.get("kind") == "soak_report"]
         if soak:
@@ -421,6 +439,18 @@ def render(info: dict) -> str:
                      f"{q} quarantine(s)"
                      + (f" shards={dp.get('quarantined_shards')}" if q else "")
                      + state)
+    rq = info.get("requests")
+    if rq:
+        lines.append(f"requests: {rq['traced']} traced — dominant tail "
+                     f"phase {rq.get('dominant_phase') or '-'}"
+                     + (f" (>= {_fmt(rq.get('tail_threshold_ms'), 3)}ms)"
+                        if rq.get("tail_threshold_ms") is not None else ""))
+        for p, s in (rq.get("phases") or {}).items():
+            lines.append(f"  {p:>14}: p50 {_fmt(s.get('p50_ms'), 3)}ms  "
+                         f"p95 {_fmt(s.get('p95_ms'), 3)}ms")
+        if rq.get("exemplars"):
+            lines.append("  exemplars: "
+                         + ", ".join(t[:12] for t in rq["exemplars"]))
     soak = info.get("soak_report")
     if soak:
         verdict = "ok" if soak.get("ok") else "NOT ok"

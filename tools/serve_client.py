@@ -26,8 +26,12 @@ Load generator (CLI)::
 
 Open-loop at ``--rps`` (one request thread per tick, so a slow service
 accumulates concurrency instead of silently lowering the offered rate);
-reports p50/p95/max request latency, 429/error counts, and the achieved
-rate. Exit 0 when every non-rejected request succeeded.
+reports p50/p95/max request latency, 429/error counts, the achieved
+rate, and the slowest-N requests WITH their ``X-Trace-Id``s (every
+request carries one; the service echoes it — feed an id to
+``tools/request_report.py`` or the Perfetto timeline for the
+server-side phase breakdown). Exit 0 when every non-rejected request
+succeeded.
 """
 
 from __future__ import annotations
@@ -41,6 +45,8 @@ import time
 import urllib.error
 import urllib.request
 import uuid
+
+TRACE_HEADER = "X-Trace-Id"
 
 
 class ServeError(Exception):
@@ -87,6 +93,9 @@ class ServeClient:
         self.retries = max(0, int(retries))
         self.backoff_s = float(backoff_s)
         self.retry_count = 0     # total retries performed (load report)
+        self.last_trace_id: str | None = None   # echoed X-Trace-Id of the
+        # last completed call — the handle that finds the request's spans
+        # in the metrics stream / Perfetto timeline
 
     @property
     def base(self) -> str:
@@ -100,11 +109,15 @@ class ServeClient:
     # ------------------------------------------------------------ plumbing
 
     def _request(self, path: str, payload: dict | None = None,
-                 idempotency_key: str | None = None):
+                 idempotency_key: str | None = None,
+                 trace_id: str | None = None):
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         if data is not None:
             headers["Idempotency-Key"] = idempotency_key or uuid.uuid4().hex
+        # One trace id per LOGICAL call, reused across retries/failovers —
+        # every attempt of this request shares one lane in the timeline.
+        headers[TRACE_HEADER] = trace_id or uuid.uuid4().hex
         attempt = 0
         eps_tried = 1   # endpoints exercised since the last budgeted retry
         while True:
@@ -113,6 +126,8 @@ class ServeClient:
             try:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout_s) as resp:
+                    self.last_trace_id = (resp.headers.get(TRACE_HEADER)
+                                          or headers[TRACE_HEADER])
                     return json.load(resp)
             except urllib.error.HTTPError as err:
                 try:
@@ -156,7 +171,8 @@ class ServeClient:
     # ------------------------------------------------------------ endpoints
 
     def score(self, *, indices=None, images=None, labels=None,
-              tenant: str | None = None, method: str | None = None) -> dict:
+              tenant: str | None = None, method: str | None = None,
+              trace_id: str | None = None) -> dict:
         payload: dict = {}
         if tenant:
             payload["tenant"] = tenant
@@ -167,7 +183,7 @@ class ServeClient:
         if images is not None:
             payload["images"] = images
             payload["labels"] = labels
-        return self._request("/v1/score", payload)
+        return self._request("/v1/score", payload, trace_id=trace_id)
 
     def rank(self, indices, *, tenant: str | None = None,
              method: str | None = None) -> dict:
@@ -191,10 +207,13 @@ class ServeClient:
             qs += f"&method={method}"
         attempt = 0
         eps_tried = 1
+        tid = uuid.uuid4().hex
         while True:
-            req = urllib.request.Request(f"{self.base}/v1/topk?{qs}")
+            req = urllib.request.Request(f"{self.base}/v1/topk?{qs}",
+                                         headers={TRACE_HEADER: tid})
             try:
                 resp = urllib.request.urlopen(req, timeout=self.timeout_s)
+                self.last_trace_id = resp.headers.get(TRACE_HEADER) or tid
             except urllib.error.HTTPError as err:
                 try:
                     body = json.load(err)
@@ -264,35 +283,51 @@ def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
                   max_index: int = 255, tenant: str | None = None,
                   method: str | None = None, timeout_s: float = 60.0,
                   seed: int = 0, retries: int = 0,
-                  backoff_s: float = 0.25) -> dict:
+                  backoff_s: float = 0.25, slowest_n: int = 5) -> dict:
     """Drive ``/v1/score`` open-loop at ``rps`` for ``duration_s``; returns
     the latency/outcome report dict ``main`` prints (and ``bench.py --task
     serve`` embeds). ``retries`` makes each request survive backpressure
     and replica churn (the fleet drills drive with retries > 0 and assert
-    errors == 0)."""
+    errors == 0). Every request carries its own ``X-Trace-Id``; the report's
+    ``slowest`` block names the ``slowest_n`` worst client-observed
+    latencies WITH their trace ids — paste one into
+    ``tools/request_report.py`` / the Perfetto timeline to see where the
+    time went server-side."""
     client = ServeClient(url, timeout_s=timeout_s, retries=retries,
                          backoff_s=backoff_s)
     rng = random.Random(seed)
     lock = threading.Lock()
     lat_ms: list[float] = []
+    per_request: list[dict] = []   # {trace_id, ms, ok} per completed request
     outcomes = {"ok": 0, "rejected": 0, "errors": 0}
     threads: list[threading.Thread] = []
 
     def one():
         ids = [rng.randrange(max_index + 1) for _ in range(batch)]
+        tid = uuid.uuid4().hex
         t0 = time.perf_counter()
         try:
-            client.score(indices=ids, tenant=tenant, method=method)
+            client.score(indices=ids, tenant=tenant, method=method,
+                         trace_id=tid)
             wall = (time.perf_counter() - t0) * 1e3
             with lock:
                 outcomes["ok"] += 1
                 lat_ms.append(wall)
+                per_request.append(
+                    {"trace_id": tid, "ms": round(wall, 3), "ok": True})
         except ServeError as err:
+            wall = (time.perf_counter() - t0) * 1e3
             with lock:
                 outcomes["rejected" if err.status == 429 else "errors"] += 1
+                per_request.append(
+                    {"trace_id": tid, "ms": round(wall, 3), "ok": False,
+                     "status": err.status})
         except Exception:   # noqa: BLE001 — a dead socket is an error outcome
+            wall = (time.perf_counter() - t0) * 1e3
             with lock:
                 outcomes["errors"] += 1
+                per_request.append(
+                    {"trace_id": tid, "ms": round(wall, 3), "ok": False})
 
     interval = 1.0 / max(rps, 1e-9)
     t_start = time.perf_counter()
@@ -319,6 +354,7 @@ def load_generate(url: str, *, rps: float, duration_s: float, batch: int = 16,
         "p50_ms": percentile(lat_ms, 0.50),
         "p95_ms": percentile(lat_ms, 0.95),
         "max_ms": max(lat_ms) if lat_ms else None,
+        "slowest": sorted(per_request, key=lambda r: -r["ms"])[:slowest_n],
     }
 
 
@@ -360,6 +396,9 @@ def main(argv: list[str] | None = None) -> int:
               f"max {report['max_ms']}")
         print(f"rate: offered {report['offered_rps']}/s  "
               f"achieved {report['achieved_rps']}/s")
+        for r in report["slowest"]:
+            flag = "" if r["ok"] else "  [failed]"
+            print(f"slowest: {r['ms']:>9.3f} ms  trace {r['trace_id']}{flag}")
     return 0 if report["errors"] == 0 else 1
 
 

@@ -12,7 +12,11 @@ failures** through every fault, judged per cycle by
 * the load report (``errors == 0 and rejected == 0``),
 * ``tools/run_monitor.py --once`` exit codes over the cycle's records
   (0 healthy / 1 SLO-violated / 2 unreachable-or-stale),
-* ``tools/validate_metrics.py`` schema validation of the stream, and
+* ``tools/validate_metrics.py`` schema validation of the stream,
+* the request observatory: every cycle's stream must carry schema-valid
+  ``serve_trace`` records AND ``tools/request_report.py`` must produce a
+  tail-attribution verdict over them (exit 0 — a stream with no traces
+  fails the cycle), and
 * fault-specific record forensics (a kill cycle must leave a
   ``replica_event`` died/respawn pair; a wedge cycle a
   wedged/wedged_reaped/respawn chain; a refresh cycle a digest-loud
@@ -220,6 +224,9 @@ def _cycle_overrides(args, cycle_dir: str, refresh_dir: str) -> list[str]:
         # tight drain bound turns the wedge recovery wall from
         # O(drain_timeout) into O(detection + respawn). The clean SIGTERM
         # drain is unaffected: it returns as soon as in-flight completes.
+        # Soak cycles are forensics runs: retain every request trace so the
+        # per-cycle attribution gate always has evidence to judge.
+        "serve.trace_sample_frac=1.0",
         "serve.drain_timeout_s=5.0", "elastic.reap_timeout_s=20",
         f"elastic.max_restarts={args.max_restarts}", "elastic.backoff_s=0.2",
         f"serve.refresh_from={refresh_dir}",
@@ -240,6 +247,21 @@ def _monitor_once(metrics: str) -> tuple[int, dict]:
     except (ValueError, IndexError):
         view = {"error": f"unparseable monitor output: {proc.stdout[-200:]}"}
     return proc.returncode, view
+
+
+def _attribution(metrics: str) -> tuple[int, dict]:
+    """``request_report.py --json`` over the cycle's stream: the exit code
+    (2 = no serve_trace records — a cycle failure) plus the report."""
+    report_tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "request_report.py")
+    proc = subprocess.run(
+        [sys.executable, report_tool, metrics, "--json"],
+        capture_output=True, text=True, timeout=60)
+    try:
+        report = json.loads(proc.stdout)
+    except ValueError:
+        report = {"error": f"unparseable report output: {proc.stdout[-200:]}"}
+    return proc.returncode, report
 
 
 def _forensics(fault: str, recs: list[dict], rc: int,
@@ -572,12 +594,22 @@ def run_cycle(args, index: int, fault: str, refresh_dir: str,
                         f"of {MONITOR_OK.get(fault, (0,))}")
     problems += [f"stream: {p}" for p in stream_problems[:5]]
     problems += _forensics(fault, recs, rc, refresh_verdicts)
+    # Request-observatory contract: the cycle must leave attributable
+    # traces — request_report exits 2 on a traceless stream, nonzero on
+    # any failure to attribute.
+    n_traces = sum(r.get("kind") == "serve_trace" for r in recs)
+    attr_exit, attr = _attribution(metrics)
+    if attr_exit != 0:
+        problems.append(f"request_report exit {attr_exit} over the stream "
+                        f"({n_traces} serve_trace record(s))")
     verdict.update(
         rc=rc, wall_s=round(time.perf_counter() - t0, 1),
         requests=sent, errors=errors, rejected=rejected,
         monitor_exit=monitor_exit, exit_class=summary.get("exit_class"),
         slo=summary.get("slo"), refresh=refresh_verdicts,
         p95_ms=(verdict.get("load") or {}).get("p95_ms"),
+        traces=n_traces,
+        dominant_phase=(attr.get("tail") or {}).get("dominant_phase"),
         problems=problems, ok=not problems)
     # Load reports are bulky; the verdict keys above carry what the
     # soak_report needs.

@@ -93,6 +93,12 @@ KNOWN_KINDS = frozenset({
     # verdict on the signed manifest (applied or skipped, with reason,
     # knobs, and the precedence-skipped set).
     "autotune_event", "tuning_applied",
+    # Request observatory (obs/reqtrace.py): one per-request distributed
+    # trace with the X-Trace-Id identity, the emitting side ("router" /
+    # "replica"), and the per-phase latency breakdown. Tail-biased
+    # retention: failed/slow/retried/hedged/replayed requests always
+    # emit; healthy traffic head-samples via serve.trace_sample_frac.
+    "serve_trace",
 })
 
 #: kind -> fields every record of that kind must carry.
@@ -176,6 +182,11 @@ REQUIRED_FIELDS: dict[str, tuple[str, ...]] = {
     # tuning_applied verdict always carries the decision triple.
     "autotune_event": ("event",),
     "tuning_applied": ("applied", "mode", "manifest"),
+    # Request traces. Null-tolerant: status may be null when the socket
+    # died before a status existed, and phases' VALUES may be null — but
+    # the identity (trace_id), the emitting side, the wall, and the
+    # phases dict itself must always be present.
+    "serve_trace": ("trace_id", "where", "status", "wall_ms", "phases"),
 }
 
 #: Valid statuses for stage events (resilience/stages.py vocabulary).
@@ -233,6 +244,11 @@ def validate_lines(lines, *, where: str = "<stream>",
                 problems.append(
                     f"{where}:{i}: kind {kind!r} missing required "
                     f"field {field!r}")
+        if kind == "serve_trace" and "phases" in rec \
+                and not isinstance(rec["phases"], dict):
+            problems.append(
+                f"{where}:{i}: serve_trace 'phases' must be an object "
+                "(phase -> ms-or-null)")
         if kind == "stage" and rec.get("status") not in STAGE_STATUSES:
             problems.append(
                 f"{where}:{i}: stage status {rec.get('status')!r} not in "
